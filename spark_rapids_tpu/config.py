@@ -1,0 +1,346 @@
+"""Typed configuration registry.
+
+Capability parity with the reference's ``RapidsConf.scala`` (832 LoC): a
+typed builder with defaults and validators, a global registry, markdown doc
+generation, and *auto-derived per-operator enable/disable keys* from the
+plan-rewrite rule framework (reference: GpuOverrides.scala:118-123 derives
+``spark.rapids.sql.<kind>.<ClassName>``).
+
+Keys here live under ``spark.rapids.tpu.*`` and mirror the reference's
+grouping: memory, scheduling, batch sizing, feature gates, test hooks,
+shuffle/exchange, explain.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+_REG_LOCK = threading.Lock()
+
+
+class ConfEntry:
+    def __init__(self, key: str, converter: Callable[[str], Any],
+                 doc: str, default: Any, is_internal: bool = False,
+                 checker: Optional[Callable[[Any], Optional[str]]] = None):
+        self.key = key
+        self.converter = converter
+        self.doc = doc
+        self.default = default
+        self.is_internal = is_internal
+        self.checker = checker
+        with _REG_LOCK:
+            if key in _REGISTRY:
+                raise ValueError(f"duplicate conf key {key}")
+            _REGISTRY[key] = self
+
+    def get(self, conf: Dict[str, Any]) -> Any:
+        if self.key in conf:
+            raw = conf[self.key]
+            val = self.converter(raw) if isinstance(raw, str) else raw
+        else:
+            env_key = self.key.upper().replace(".", "_")
+            if env_key in os.environ:
+                val = self.converter(os.environ[env_key])
+            else:
+                return self.default
+        if self.checker is not None:
+            err = self.checker(val)
+            if err:
+                raise ValueError(f"{self.key}: {err}")
+        return val
+
+    def help(self) -> str:
+        return f"{self.key} — {self.doc} (default: {self.default})"
+
+
+def _to_bool(s: str) -> bool:
+    return s.strip().lower() in ("true", "1", "yes", "on")
+
+
+class ConfBuilder:
+    """``conf("key").doc(...).boolean_conf(default)`` builder, mirroring the
+    reference's ``ConfBuilder``/``TypedConfBuilder`` (RapidsConf.scala:128-206)."""
+
+    def __init__(self, key: str):
+        self.key = key
+        self._doc = ""
+        self._internal = False
+        self._checker = None
+
+    def doc(self, text: str) -> "ConfBuilder":
+        self._doc = text
+        return self
+
+    def internal(self) -> "ConfBuilder":
+        self._internal = True
+        return self
+
+    def check(self, fn: Callable[[Any], Optional[str]]) -> "ConfBuilder":
+        self._checker = fn
+        return self
+
+    def _mk(self, conv, default):
+        return ConfEntry(self.key, conv, self._doc, default,
+                         self._internal, self._checker)
+
+    def boolean_conf(self, default: bool) -> ConfEntry:
+        return self._mk(_to_bool, default)
+
+    def int_conf(self, default: int) -> ConfEntry:
+        return self._mk(int, default)
+
+    def long_conf(self, default: int) -> ConfEntry:
+        return self._mk(int, default)
+
+    def double_conf(self, default: float) -> ConfEntry:
+        return self._mk(float, default)
+
+    def string_conf(self, default: Optional[str]) -> ConfEntry:
+        return self._mk(str, default)
+
+
+def conf(key: str) -> ConfBuilder:
+    return ConfBuilder(key)
+
+
+def lookup(key: str) -> Optional[ConfEntry]:
+    return _REGISTRY.get(key)
+
+
+def register_op_enable_key(kind: str, name: str, doc: str,
+                           default: bool = True) -> ConfEntry:
+    """Auto-derived per-operator key, e.g.
+    ``spark.rapids.tpu.sql.exec.SortExec`` (reference GpuOverrides.scala:118-123).
+
+    Idempotent per key."""
+    key = f"spark.rapids.tpu.sql.{kind}.{name}"
+    existing = lookup(key)
+    if existing is not None:
+        return existing
+    return conf(key).doc(doc).boolean_conf(default)
+
+
+def dump_markdown() -> str:
+    """Generate the configs doc table (reference: docs/configs.md is generated
+    from the registry, RapidsConf.scala help/makeConfAnchor)."""
+    lines = ["# Configuration", "",
+             "| Key | Default | Description |", "|---|---|---|"]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.is_internal:
+            continue
+        lines.append(f"| `{key}` | {e.default} | {e.doc} |")
+    return "\n".join(lines)
+
+
+# ==========================================================================
+# Global entries (grouping mirrors RapidsConf.scala:221-584)
+# ==========================================================================
+
+# --- memory (spark.rapids.memory.* :221-269) ------------------------------
+DEVICE_MEMORY_FRACTION = conf("spark.rapids.tpu.memory.allocFraction").doc(
+    "Fraction of device HBM the engine treats as its working arena; "
+    "admission control and spill thresholds derive from it").double_conf(0.9)
+HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.tpu.memory.host.spillStorageSize").doc(
+    "Bytes of host memory used to spill device batches before disk").long_conf(
+    1024 * 1024 * 1024)
+DEVICE_MEMORY_DEBUG = conf("spark.rapids.tpu.memory.debug").doc(
+    "Log device allocations/frees").boolean_conf(False)
+PINNED_POOL_SIZE = conf("spark.rapids.tpu.memory.pinnedPool.size").doc(
+    "Bytes of page-locked host staging memory for device transfers "
+    "(advisory on TPU; transfers go through the runtime)").long_conf(0)
+
+# --- scheduling -----------------------------------------------------------
+CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
+    "Number of tasks that may hold the device semaphore concurrently "
+    "(reference: spark.rapids.sql.concurrentGpuTasks)").int_conf(2)
+SHUFFLE_SPILL_THREADS = conf("spark.rapids.tpu.shuffle.spillThreads").doc(
+    "Threads used to spill shuffle data to disk in the background").int_conf(6)
+TASK_THREADS = conf("spark.rapids.tpu.sql.taskThreads").doc(
+    "Host task-runner threads per process (partition-level data "
+    "parallelism)").int_conf(8)
+
+# --- batch sizing (:289-309) ---------------------------------------------
+BATCH_SIZE_BYTES = conf("spark.rapids.tpu.sql.batchSizeBytes").doc(
+    "Target byte size for device batches; coalescing aims for this").long_conf(
+    512 * 1024 * 1024)
+BATCH_SIZE_ROWS = conf("spark.rapids.tpu.sql.batchSizeRows").doc(
+    "Soft cap on rows per device batch").int_conf(1 << 22)
+READER_BATCH_SIZE_ROWS = conf("spark.rapids.tpu.sql.reader.batchSizeRows").doc(
+    "Soft cap on rows per reader batch (reference: "
+    "spark.rapids.sql.reader.batchSizeRows)").int_conf(1 << 21)
+READER_BATCH_SIZE_BYTES = conf("spark.rapids.tpu.sql.reader.batchSizeBytes").doc(
+    "Soft cap on bytes per reader batch").long_conf(512 * 1024 * 1024)
+BUCKET_MIN_ROWS = conf("spark.rapids.tpu.sql.bucketMinRows").doc(
+    "Device batches are padded to power-of-two row buckets >= this, so XLA "
+    "compile caches hit across batches (TPU-specific: static shapes)").int_conf(128)
+
+# --- feature gates (:328-449) --------------------------------------------
+SQL_ENABLED = conf("spark.rapids.tpu.sql.enabled").doc(
+    "Master enable for the plan-rewrite engine").boolean_conf(True)
+INCOMPATIBLE_OPS = conf("spark.rapids.tpu.sql.incompatibleOps.enabled").doc(
+    "Allow ops whose results may diverge from the host engine in corner "
+    "cases (reference: spark.rapids.sql.incompatibleOps.enabled)").boolean_conf(False)
+ALLOW_FLOAT_AGG = conf("spark.rapids.tpu.sql.variableFloatAgg.enabled").doc(
+    "Allow float aggregation despite non-deterministic ordering of "
+    "partial results").boolean_conf(False)
+HAS_NANS = conf("spark.rapids.tpu.sql.hasNans").doc(
+    "Assume float data may contain NaNs (gates some comparisons/joins)"
+).boolean_conf(True)
+ALLOW_FLOAT64_AS_32 = conf("spark.rapids.tpu.sql.float64AsFloat32.enabled").doc(
+    "On TPU generations without fp64 ALUs, compute double columns in "
+    "float32 (documented incompatibility)").boolean_conf(False)
+CAST_STRING_TO_FLOAT = conf("spark.rapids.tpu.sql.castStringToFloat.enabled").doc(
+    "Enable string->float casts (corner-case divergences documented)"
+).boolean_conf(False)
+CAST_FLOAT_TO_STRING = conf("spark.rapids.tpu.sql.castFloatToString.enabled").doc(
+    "Enable float->string casts (formatting divergences documented)"
+).boolean_conf(False)
+CAST_STRING_TO_TIMESTAMP = conf(
+    "spark.rapids.tpu.sql.castStringToTimestamp.enabled").doc(
+    "Enable string->timestamp casts").boolean_conf(False)
+CAST_STRING_TO_INTEGER = conf(
+    "spark.rapids.tpu.sql.castStringToInteger.enabled").doc(
+    "Enable string->integral casts").boolean_conf(False)
+IMPROVED_FLOAT_OPS = conf("spark.rapids.tpu.sql.improvedFloatOps.enabled").doc(
+    "Use faster float paths that may differ in ULPs from the host engine"
+).boolean_conf(False)
+ENABLE_REPLACE_SORT_MERGE_JOIN = conf(
+    "spark.rapids.tpu.sql.replaceSortMergeJoin.enabled").doc(
+    "Replace host sort-merge joins with device joins; on TPU the device "
+    "join itself is sort-based (reference replaces SMJ with hash join — "
+    "the efficient frontier is reversed on TPU)").boolean_conf(True)
+ENABLE_PARQUET = conf("spark.rapids.tpu.sql.format.parquet.enabled").doc(
+    "Enable Parquet scans/writes").boolean_conf(True)
+ENABLE_PARQUET_READ = conf("spark.rapids.tpu.sql.format.parquet.read.enabled").doc(
+    "Enable Parquet scans").boolean_conf(True)
+ENABLE_PARQUET_WRITE = conf("spark.rapids.tpu.sql.format.parquet.write.enabled").doc(
+    "Enable Parquet writes").boolean_conf(True)
+ENABLE_ORC = conf("spark.rapids.tpu.sql.format.orc.enabled").doc(
+    "Enable ORC scans/writes").boolean_conf(True)
+ENABLE_ORC_READ = conf("spark.rapids.tpu.sql.format.orc.read.enabled").doc(
+    "Enable ORC scans").boolean_conf(True)
+ENABLE_ORC_WRITE = conf("spark.rapids.tpu.sql.format.orc.write.enabled").doc(
+    "Enable ORC writes").boolean_conf(True)
+ENABLE_CSV = conf("spark.rapids.tpu.sql.format.csv.enabled").doc(
+    "Enable CSV scans").boolean_conf(True)
+ENABLE_CSV_READ = conf("spark.rapids.tpu.sql.format.csv.read.enabled").doc(
+    "Enable CSV scans").boolean_conf(True)
+FULL_TIMESTAMP_PARSE = conf("spark.rapids.tpu.sql.csv.read.timestamps.enabled").doc(
+    "Enable CSV timestamp parsing").boolean_conf(False)
+
+# --- test hooks (:456-463) ------------------------------------------------
+TEST_ENABLED = conf("spark.rapids.tpu.sql.test.enabled").doc(
+    "Test mode: fail if any operator unexpectedly stays on the host engine "
+    "(reference: spark.rapids.sql.test.enabled)").internal().boolean_conf(False)
+TEST_ALLOWED_NON_TPU = conf("spark.rapids.tpu.sql.test.allowedNonTpu").doc(
+    "Comma-separated operator class names permitted to fall back when test "
+    "mode is on").internal().string_conf("")
+
+# --- debug ----------------------------------------------------------------
+EXPLAIN = conf("spark.rapids.tpu.sql.explain").doc(
+    "Plan-rewrite explain mode: NONE, ALL, or NOT_ON_TPU").string_conf("NONE")
+DEBUG_DUMP_PREFIX = conf("spark.rapids.tpu.sql.debug.dumpPrefix").doc(
+    "If set, dump input batches of failing ops under this path prefix"
+).string_conf("")
+
+# --- aggregation modes (:483-493) ----------------------------------------
+HASH_AGG_REPLACE_MODE = conf("spark.rapids.tpu.sql.hashAgg.replaceMode").doc(
+    "Which aggregation modes to replace: all, partial, final").string_conf("all")
+
+# --- shuffle / exchange (spark.rapids.shuffle.* :500-576) -----------------
+SHUFFLE_TRANSPORT_CLASS = conf("spark.rapids.tpu.shuffle.transport.class").doc(
+    "Transport used for device-to-device exchange; default is the ICI "
+    "collective transport (reference default is the UCX transport)"
+).string_conf("spark_rapids_tpu.parallel.collective.IciCollectiveTransport")
+SHUFFLE_MAX_INFLIGHT = conf(
+    "spark.rapids.tpu.shuffle.maxReceiveInflightBytes").doc(
+    "Throttle on concurrently in-flight receive bytes for the host relay "
+    "path").long_conf(1024 * 1024 * 1024)
+SHUFFLE_COMPRESS = conf("spark.rapids.tpu.shuffle.compress").doc(
+    "Compress host-relay shuffle payloads").boolean_conf(False)
+SHUFFLE_PARTITIONS = conf("spark.rapids.tpu.sql.shuffle.partitions").doc(
+    "Default number of exchange output partitions").int_conf(8)
+
+# --- ML interop -----------------------------------------------------------
+EXPORT_COLUMNAR_RDD = conf("spark.rapids.tpu.sql.exportColumnarRdd").doc(
+    "Allow zero-copy export of device batches to user code (JAX arrays); "
+    "reference: spark.rapids.sql.exportColumnarRdd").boolean_conf(False)
+
+# --- metrics / tracing ----------------------------------------------------
+TRACE_ENABLED = conf("spark.rapids.tpu.sql.trace.enabled").doc(
+    "Wrap hot-path sections in jax.profiler trace annotations (reference: "
+    "NVTX ranges)").boolean_conf(False)
+
+
+class TpuConf:
+    """Immutable view over a key->value dict with typed accessors.
+
+    ``TpuConf({...})`` or ``TpuConf()`` for defaults."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+
+    def get(self, entry: ConfEntry):
+        return entry.get(self._settings)
+
+    def get_key(self, key: str):
+        e = lookup(key)
+        if e is None:
+            return self._settings.get(key)
+        return e.get(self._settings)
+
+    def is_operator_enabled(self, kind: str, name: str) -> bool:
+        e = lookup(f"spark.rapids.tpu.sql.{kind}.{name}")
+        if e is None:
+            return True
+        return e.get(self._settings)
+
+    def with_settings(self, **kv) -> "TpuConf":
+        s = dict(self._settings)
+        s.update(kv)
+        return TpuConf(s)
+
+    def set(self, key: str, value) -> "TpuConf":
+        s = dict(self._settings)
+        s[key] = value
+        return TpuConf(s)
+
+    # Convenience typed properties used on hot paths
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def batch_size_rows(self) -> int:
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def is_sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def is_test_enabled(self) -> bool:
+        return self.get(TEST_ENABLED)
+
+    @property
+    def allowed_non_tpu(self) -> List[str]:
+        raw = self.get(TEST_ALLOWED_NON_TPU)
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+    @property
+    def explain(self) -> str:
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def concurrent_tpu_tasks(self) -> int:
+        return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.get(SHUFFLE_PARTITIONS)
+
+    def items(self):
+        return self._settings.items()

@@ -1,0 +1,71 @@
+"""Device string encoding.
+
+XLA needs static shapes, so variable-width strings are hostile to the device
+path (SURVEY §7 "Strings on TPU").  The device representation here is a
+fixed-width padded byte matrix:
+
+    bytes:   uint8[rows, max_len]   (UTF-8 payload, zero padded)
+    lengths: int32[rows]            (byte length per row)
+
+This supports vectorized upper/lower/substring/length/contains/starts/ends/
+concat/compare on the VPU.  Regex-class ops fall back to the host engine,
+mirroring the reference's regex bail-outs (GpuOverrides.scala:326-371).
+
+Host-side strings are ``object`` ndarrays of python ``str``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def encode(values: np.ndarray, validity: Optional[np.ndarray],
+           max_len: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode an object ndarray of str into (bytes[rows,max_len], lengths)."""
+    n = len(values)
+    encoded = []
+    for i in range(n):
+        if validity is not None and not validity[i]:
+            encoded.append(b"")
+        else:
+            v = values[i]
+            encoded.append(v.encode("utf-8") if isinstance(v, str)
+                           else (v if isinstance(v, bytes) else b""))
+    lengths = np.fromiter((len(b) for b in encoded), dtype=np.int32, count=n)
+    ml = int(lengths.max()) if n else 0
+    if max_len is None:
+        max_len = max(1, ml)
+    elif ml > max_len:
+        raise ValueError(f"string of {ml} bytes exceeds max_len {max_len}")
+    out = np.zeros((n, max_len), dtype=np.uint8)
+    for i, b in enumerate(encoded):
+        if b:
+            out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return out, lengths
+
+
+def decode(byte_mat: np.ndarray, lengths: np.ndarray,
+           validity: Optional[np.ndarray] = None) -> np.ndarray:
+    """Decode (bytes, lengths) back to an object ndarray of str."""
+    n = byte_mat.shape[0]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        if validity is not None and not validity[i]:
+            out[i] = None
+        else:
+            ln = int(lengths[i])
+            out[i] = bytes(byte_mat[i, :ln]).decode("utf-8", errors="replace")
+    return out
+
+
+def pad_rows(byte_mat: np.ndarray, lengths: np.ndarray,
+             target_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    n, w = byte_mat.shape
+    if target_rows == n:
+        return byte_mat, lengths
+    bm = np.zeros((target_rows, w), dtype=np.uint8)
+    bm[:n] = byte_mat
+    ln = np.zeros(target_rows, dtype=np.int32)
+    ln[:n] = lengths
+    return bm, ln

@@ -1,0 +1,17 @@
+"""TPU device operators."""
+
+
+def register_rules(register_exec):
+    """Register exec rules for operators implemented in this package.
+    Called once by plan.overrides._register_exec_rules; grows as device
+    operators land (aggregate, sort, join, exchange, window)."""
+    import importlib
+
+    for name in ("aggregate", "sort", "joins", "exchange", "window"):
+        try:
+            mod = importlib.import_module(f".{name}", __package__)
+        except ImportError:
+            continue
+        reg = getattr(mod, "register", None)
+        if reg is not None:
+            reg(register_exec)

@@ -1,0 +1,112 @@
+"""TPU exec base.
+
+Reference analogue: GpuExec.scala — ``supportsColumnar``, the standard
+metric set, per-exec coalesce goals.  A TpuExec executes to device
+partitions (``DevicePartitionedData`` of DeviceBatches in HBM); its
+row-oriented ``execute`` is only reachable through a DeviceToHostExec
+transition inserted by the rewrite engine.
+
+Each exec compiles ONE jitted kernel; jax's compile cache keys on the
+(schema, row-bucket) shapes, so batches sharing a bucket reuse the
+executable — the static-shape answer to cudf's dynamic kernels.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Sequence
+
+from .. import types as T
+from ..data.column import DeviceBatch
+from ..plan.physical import ExecContext, PhysicalPlan
+from ..utils import metrics as M
+
+
+# --------------------------------------------------------------------------
+# Coalesce goals (reference: CoalesceGoal lattice, GpuCoalesceBatches.scala)
+# --------------------------------------------------------------------------
+class CoalesceGoal:
+    def max_with(self, other: "CoalesceGoal") -> "CoalesceGoal":
+        if isinstance(self, RequireSingleBatch) or \
+                isinstance(other, RequireSingleBatch):
+            return RequireSingleBatch()
+        if isinstance(self, TargetSize) and isinstance(other, TargetSize):
+            return self if self.target >= other.target else other
+        return self
+
+
+class TargetSize(CoalesceGoal):
+    def __init__(self, target: int):
+        self.target = target
+
+    def __repr__(self):  # pragma: no cover
+        return f"TargetSize({self.target})"
+
+
+class RequireSingleBatch(CoalesceGoal):
+    def __repr__(self):  # pragma: no cover
+        return "RequireSingleBatch"
+
+
+class DevicePartitionedData:
+    def __init__(self, parts: List[Callable[[], Iterator[DeviceBatch]]]):
+        self.parts = parts
+
+    @property
+    def n_partitions(self):
+        return len(self.parts)
+
+    def iterator(self, pid: int) -> Iterator[DeviceBatch]:
+        from ..ops import miscexprs
+
+        miscexprs.context.partition_id = pid
+        miscexprs.context.row_offset = 0
+        return self.parts[pid]()
+
+
+class TpuExec(PhysicalPlan):
+    """Base of all device operators."""
+
+    def __init__(self, children: Sequence[PhysicalPlan] = ()):  # noqa
+        super().__init__(children)
+        self.metrics = {}
+
+    # standard metric names (reference: GpuMetricNames)
+    def _init_metrics(self, ctx: ExecContext):
+        reg = ctx.metrics
+        prefix = f"{self.name}."
+        self.metrics = {
+            M.NUM_OUTPUT_ROWS: reg.metric(prefix + M.NUM_OUTPUT_ROWS),
+            M.NUM_OUTPUT_BATCHES: reg.metric(prefix + M.NUM_OUTPUT_BATCHES),
+            M.TOTAL_TIME: reg.metric(prefix + M.TOTAL_TIME, "ns"),
+            M.PEAK_DEVICE_MEMORY: reg.metric(
+                prefix + M.PEAK_DEVICE_MEMORY, "max"),
+        }
+
+    @property
+    def supports_columnar(self) -> bool:
+        return True
+
+    # goals the exec imposes on each child's batches
+    @property
+    def children_coalesce_goal(self) -> List[CoalesceGoal]:
+        return [None] * len(self.children)
+
+    # goal describing this exec's own output batching
+    @property
+    def coalesce_after(self) -> bool:
+        """True if output batches may be tiny and benefit from coalescing
+        above (reference: GpuExec.coalesceAfter)."""
+        return False
+
+    def execute_columnar(self, ctx: ExecContext) -> DevicePartitionedData:
+        raise NotImplementedError(f"{self.name}.execute_columnar")
+
+    def execute(self, ctx: ExecContext):
+        """Row path is reached only through transitions — mirror of the
+        reference's GpuExec.doExecute throwing."""
+        raise RuntimeError(
+            f"{self.name} does not support host execution; a "
+            "DeviceToHostExec transition should have been inserted")
+
+    def _sem(self, ctx: ExecContext):
+        dm = ctx.session.device_manager if ctx.session else None
+        return dm.semaphore if dm else None

@@ -1,0 +1,101 @@
+"""Host<->device columnar transitions.
+
+Reference analogue: GpuRowToColumnarExec (upload), GpuColumnarToRowExec
+(download), HostColumnarToGpu, GpuBringBackToHost.  The host engine here
+is already columnar, so the transitions are HostBatch <-> DeviceBatch
+transfers: HostToDeviceExec acquires the device semaphore just before
+upload (the reference acquires just before GPU decode,
+GpuParquetScan.scala:554)."""
+from __future__ import annotations
+
+from ..data.column import device_to_host, host_to_device
+from ..config import BUCKET_MIN_ROWS
+from ..plan.physical import PartitionedData
+from ..utils import metrics as M
+from ..utils.tracing import trace_range
+from .base import DevicePartitionedData, TpuExec
+
+
+class HostToDeviceExec(TpuExec):
+    """Upload host batches to device HBM (GpuRowToColumnarExec /
+    HostColumnarToGpu analogue)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    @property
+    def coalesce_after(self) -> bool:
+        return True
+
+    def execute_columnar(self, ctx) -> DevicePartitionedData:
+        child_data = self.children[0].execute(ctx)
+        self._init_metrics(ctx)
+        sem = self._sem(ctx)
+        min_rows = ctx.conf.get(BUCKET_MIN_ROWS)
+
+        def make(pid):
+            def it():
+                for batch in child_data.iterator(pid):
+                    if sem:
+                        sem.acquire_if_necessary()
+                    with trace_range("HostToDevice",
+                                     self.metrics[M.TOTAL_TIME]):
+                        db = host_to_device(batch, min_rows)
+                    self.metrics[M.NUM_OUTPUT_ROWS].add(batch.num_rows)
+                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                    yield db
+
+            return it
+
+        return DevicePartitionedData(
+            [make(i) for i in range(child_data.n_partitions)])
+
+    def describe(self):
+        return "HostToDevice"
+
+
+class DeviceToHostExec(TpuExec):
+    """Download device batches to the host engine (GpuColumnarToRowExec /
+    GpuBringBackToHost analogue).  Releases the device semaphore after
+    download so queued tasks can enter."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx) -> PartitionedData:
+        child_data = self.children[0].execute_columnar(ctx)
+        self._init_metrics(ctx)
+        sem = self._sem(ctx)
+
+        def make(pid):
+            def it():
+                for db in child_data.iterator(pid):
+                    with trace_range("DeviceToHost",
+                                     self.metrics[M.TOTAL_TIME]):
+                        hb = device_to_host(db)
+                    if sem:
+                        sem.release_if_necessary()
+                    self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
+                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                    yield hb
+                if sem:
+                    sem.release_if_necessary()
+
+            return it
+
+        return PartitionedData(
+            [make(i) for i in range(child_data.n_partitions)])
+
+    def execute_columnar(self, ctx):
+        raise RuntimeError("DeviceToHostExec is a host boundary")
+
+    def describe(self):
+        return "DeviceToHost"

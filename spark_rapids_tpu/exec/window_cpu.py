@@ -1,0 +1,202 @@
+"""Host-engine window exec.
+
+Reference analogue: the CPU side of GpuWindowExec — the oracle the device
+window exec is compared against.  Per-partition-key segment computation in
+numpy."""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import HostBatch, HostColumn
+from ..ops.aggregates import AggregateFunction, Average, Count, Sum
+from ..ops.expression import as_host_column
+from ..ops.kernels import segment as seg
+from ..ops.windowexprs import (
+    DenseRank,
+    Rank,
+    RowNumber,
+    WindowExpression,
+    WindowFunctionBase,
+)
+from ..plan.physical import PartitionedData, PhysicalPlan
+
+
+def _frame_bounds(frame, i, seg_lo, seg_hi):
+    lo = seg_lo if frame.lower is None else max(seg_lo, i + frame.lower)
+    hi = seg_hi if frame.upper is None else min(seg_hi, i + frame.upper + 1)
+    return lo, max(hi, lo)
+
+
+def compute_window_host(batch: HostBatch,
+                        wx: WindowExpression) -> HostColumn:
+    n = batch.num_rows
+    spec = wx.spec
+    part_cols = [as_host_column(e.eval_cpu(batch), n)
+                 for e in spec.partition_by]
+    order_keys = spec.order_by
+    order_cols = [as_host_column(k.expr.eval_cpu(batch), n)
+                  for k in order_keys]
+    # global order: partition keys asc, then order keys
+    all_cols = part_cols + order_cols
+    desc = [False] * len(part_cols) + [not k.ascending for k in order_keys]
+    nf = [True] * len(part_cols) + [k.nulls_first for k in order_keys]
+    order = seg.lexsort_np(all_cols, desc, nf) if all_cols else np.arange(n)
+    # segments by partition keys over sorted order
+    if part_cols:
+        sorted_parts = [c.take(order) for c in part_cols]
+        _, seg_ids, seg_starts = _segments_presorted(sorted_parts)
+    else:
+        seg_ids = np.zeros(n, dtype=np.int64)
+        seg_starts = np.asarray([0] if n else [], dtype=np.int64)
+
+    func = wx.func
+    frame = spec.resolved_frame()
+    out_sorted, validity_sorted = _compute_sorted(
+        batch, wx, order, seg_ids, seg_starts, n)
+    # scatter back to original row order
+    inv = np.empty(n, dtype=np.int64)
+    inv[order] = np.arange(n)
+    data = out_sorted[inv]
+    validity = None if validity_sorted is None else validity_sorted[inv]
+    return HostColumn(wx.dtype, data, validity)
+
+
+def _segments_presorted(sorted_cols):
+    n = sorted_cols[0].num_rows
+    change = np.zeros(n, dtype=np.bool_)
+    if n:
+        change[0] = True
+    for col in sorted_cols:
+        data, is_null = seg._null_key_np(col)
+        if n > 1:
+            if col.dtype.is_string:
+                neq = np.asarray(
+                    [False] + [data[i] != data[i - 1]
+                               or is_null[i] != is_null[i - 1]
+                               for i in range(1, n)])
+            else:
+                neq = np.zeros(n, dtype=np.bool_)
+                neq[1:] = (data[1:] != data[:-1]) | \
+                    (is_null[1:] != is_null[:-1])
+            change |= neq
+    seg_ids = np.cumsum(change) - 1 if n else np.zeros(0, np.int64)
+    return None, seg_ids.astype(np.int64), np.nonzero(change)[0]
+
+
+def _compute_sorted(batch, wx, order, seg_ids, seg_starts, n):
+    func = wx.func
+    frame = wx.spec.resolved_frame()
+    seg_start_of_row = seg_starts[seg_ids] if n else np.zeros(0, np.int64)
+    idx = np.arange(n)
+    if isinstance(func, RowNumber):
+        return (idx - seg_start_of_row + 1).astype(np.int32), None
+    if isinstance(func, (Rank, DenseRank)):
+        order_cols = [as_host_column(k.expr.eval_cpu(batch), n).take(order)
+                      for k in wx.spec.order_by]
+        _, okey_ids, _ = _segments_presorted(order_cols) if order_cols \
+            else (None, idx.copy(), None)
+        # ties share a value; okey change points restart counters
+        rank = np.zeros(n, dtype=np.int32)
+        dense = np.zeros(n, dtype=np.int32)
+        last_seg = -1
+        last_okey = -1
+        cur_rank = cur_dense = 0
+        for i in range(n):
+            if seg_ids[i] != last_seg:
+                last_seg = seg_ids[i]
+                last_okey = okey_ids[i]
+                cur_rank = 1
+                cur_dense = 1
+            elif okey_ids[i] != last_okey:
+                last_okey = okey_ids[i]
+                cur_rank = i - seg_start_of_row[i] + 1
+                cur_dense += 1
+            rank[i] = cur_rank
+            dense[i] = cur_dense
+        return (rank if isinstance(func, Rank) else dense), None
+    assert isinstance(func, AggregateFunction)
+    child = func.child
+    if child is None:
+        vals = np.ones(n, dtype=np.int64)
+        valid = np.ones(n, dtype=np.bool_)
+        vdtype = T.INT64
+    else:
+        c = as_host_column(child.eval_cpu(batch), n).take(order)
+        vals, valid, vdtype = c.data, c.is_valid(), c.dtype
+    out_dtype = func.dtype
+    if out_dtype.id is T.TypeId.STRING:
+        out = np.empty(n, dtype=object)
+    else:
+        out = np.zeros(n, dtype=out_dtype.np_dtype)
+    out_valid = np.ones(n, dtype=np.bool_)
+    # segment extents
+    n_seg = len(seg_starts)
+    seg_ends = np.append(seg_starts[1:], n)
+    for i in range(n):
+        lo, hi = _frame_bounds(frame, i, seg_start_of_row[i],
+                               seg_ends[seg_ids[i]])
+        v = vals[lo:hi]
+        ok = valid[lo:hi]
+        vv = v[ok] if vdtype.id is T.TypeId.STRING else v[ok]
+        if isinstance(func, Count):
+            out[i] = len(vv)
+        elif len(vv) == 0:
+            out_valid[i] = False
+        elif isinstance(func, Sum):
+            out[i] = vv.sum()
+        elif isinstance(func, Average):
+            out[i] = float(np.asarray(vv, dtype=np.float64).sum()) / len(vv)
+        elif func.name == "min":
+            out[i] = vv.min() if vdtype.id is not T.TypeId.STRING \
+                else min(vv)
+        elif func.name == "max":
+            out[i] = vv.max() if vdtype.id is not T.TypeId.STRING \
+                else max(vv)
+        elif func.name == "first":
+            out[i] = vv[0]
+        elif func.name == "last":
+            out[i] = vv[-1]
+        else:
+            raise NotImplementedError(func.name)
+    return out, (None if out_valid.all() else out_valid)
+
+
+class WindowExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan,
+                 window_exprs: List[WindowExpression], names: List[str]):
+        super().__init__([child])
+        self.window_exprs = [w.bind(child.schema) for w in window_exprs]
+        self.names = names
+        fields = list(child.schema.fields)
+        for nme, w in zip(names, self.window_exprs):
+            fields.append(T.Field(nme, w.dtype, True))
+        self._schema = T.Schema(fields)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self, ctx):
+        child = self.children[0].execute(ctx)
+
+        def make(pid):
+            def it():
+                batches = list(child.iterator(pid))
+                if not batches:
+                    return
+                batch = HostBatch.concat(batches) if len(batches) > 1 \
+                    else batches[0]
+                cols = list(batch.columns)
+                for w in self.window_exprs:
+                    cols.append(compute_window_host(batch, w))
+                yield HostBatch(self._schema, cols)
+
+            return it
+
+        return PartitionedData([make(i) for i in range(child.n_partitions)])
+
+    def describe(self):
+        return f"Window[{', '.join(w.sql() for w in self.window_exprs)}]"

@@ -1,0 +1,102 @@
+"""Device manager — device acquisition and memory arena sizing.
+
+Reference analogue: GpuDeviceManager.scala (one-GPU-per-executor
+acquisition, RMM pool init as fraction of device memory, pinned pool) and
+the executor-plugin init path (Plugin.scala:219-247).
+
+On TPU the runtime owns physical HBM; the manager tracks a *logical*
+arena — ``allocFraction`` × device memory — that the spill framework and
+admission control budget against, and installs the alloc-failure -> spill
+hook (reference: DeviceMemoryEventHandler)."""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..config import (
+    CONCURRENT_TPU_TASKS,
+    DEVICE_MEMORY_DEBUG,
+    DEVICE_MEMORY_FRACTION,
+    TpuConf,
+)
+from .semaphore import DeviceSemaphore
+
+log = logging.getLogger(__name__)
+
+_DEFAULT_HBM_BYTES = 16 * 1024 ** 3  # v5e chip, used when query fails
+
+
+class DeviceManager:
+    """Process singleton (reference: one GPU per executor —
+    GpuDeviceManager.scala:98-112 throws on more; here one process drives
+    one local device set)."""
+
+    _instance: Optional["DeviceManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: TpuConf):
+        import jax
+
+        self.conf = conf
+        self.devices = jax.devices()
+        self.device = self.devices[0]
+        self.platform = self.device.platform
+        total = self._query_memory()
+        self.arena_bytes = int(total * conf.get(DEVICE_MEMORY_FRACTION))
+        self.debug = conf.get(DEVICE_MEMORY_DEBUG)
+        self.semaphore = DeviceSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+        self._allocated = 0
+        self._alloc_lock = threading.Lock()
+        self._peak = 0
+        self.event_handler = None  # installed by spill framework
+        if self.debug:
+            log.info("DeviceManager: %s, arena=%d bytes",
+                     self.device, self.arena_bytes)
+
+    @classmethod
+    def get_or_create(cls, conf: TpuConf) -> "DeviceManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DeviceManager(conf)
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._instance = None
+
+    def _query_memory(self) -> int:
+        try:
+            stats = self.device.memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"])
+        except Exception:  # noqa: BLE001
+            pass
+        return _DEFAULT_HBM_BYTES
+
+    # ----- logical arena accounting (RMM-pool analogue) -------------------
+    def track_alloc(self, nbytes: int) -> None:
+        """Record a device allocation; fires the event handler (spill) when
+        the logical arena would overflow (reference:
+        DeviceMemoryEventHandler.onAllocFailure)."""
+        with self._alloc_lock:
+            self._allocated += nbytes
+            self._peak = max(self._peak, self._allocated)
+            over = self._allocated - self.arena_bytes
+        if over > 0 and self.event_handler is not None:
+            self.event_handler.on_alloc_threshold(over)
+        if self.debug:
+            log.info("alloc %d (total %d)", nbytes, self._allocated)
+
+    def track_free(self, nbytes: int) -> None:
+        with self._alloc_lock:
+            self._allocated = max(0, self._allocated - nbytes)
+
+    @property
+    def allocated_bytes(self) -> int:
+        return self._allocated
+
+    @property
+    def peak_bytes(self) -> int:
+        return self._peak

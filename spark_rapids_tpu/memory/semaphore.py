@@ -1,0 +1,37 @@
+"""Device admission semaphore.
+
+Reference analogue: GpuSemaphore.scala — limits concurrent tasks holding
+the device (default small), acquired just before device work (e.g. right
+before upload/decode, GpuParquetScan.scala:554) and released while tasks do
+host/IO work, so host-side decode overlaps device compute."""
+from __future__ import annotations
+
+import threading
+
+
+class DeviceSemaphore:
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.Semaphore(permits)
+        self._held = threading.local()
+
+    def acquire_if_necessary(self) -> None:
+        """Idempotent per-thread acquire (a task re-entering device code
+        does not double-count — reference GpuSemaphore.acquireIfNecessary)."""
+        if getattr(self._held, "count", 0) == 0:
+            self._sem.acquire()
+        self._held.count = getattr(self._held, "count", 0) + 1
+
+    def release_if_necessary(self) -> None:
+        count = getattr(self._held, "count", 0)
+        if count > 0:
+            self._held.count = count - 1
+            if self._held.count == 0:
+                self._sem.release()
+
+    def __enter__(self):
+        self.acquire_if_necessary()
+        return self
+
+    def __exit__(self, *exc):
+        self.release_if_necessary()

@@ -1,0 +1,191 @@
+"""Declarative aggregate functions.
+
+Capability parity with the reference's AggregateFunctions.scala: Count,
+Sum, Min, Max, Average, First, Last as *declarative* aggregates — each
+describes its partial-buffer reductions (``updates``), how partials merge
+across batches/partitions (``merges``), and a finalize expression — the
+same CudfAggregate-atom design, re-targeted at segment reductions.
+
+The aggregate exec drives these through the sort+segment-reduce kernels
+(kernels/segment.py) on either engine.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .. import types as T
+from .arithmetic import Divide
+from .expression import BoundReference, Expression
+
+
+class AggregateFunction:
+    """One aggregate call, e.g. sum(x)."""
+
+    #: list of (op, which) where op in {sum,min,max,count,first,last} and
+    #: ``which`` selects the input: 0 = the child column
+    updates: List[Tuple[str, int]] = []
+    #: ops merging each partial buffer across batches (parallel to updates)
+    merges: List[str] = []
+
+    def __init__(self, child: Optional[Expression],
+                 ignore_nulls: bool = True):
+        self.child = child
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def children(self):
+        return [] if self.child is None else [self.child]
+
+    @property
+    def dtype(self) -> T.DType:
+        raise NotImplementedError
+
+    @property
+    def name(self):
+        return type(self).__name__.lower()
+
+    def buffer_dtypes(self) -> List[T.DType]:
+        """dtypes of the partial buffers produced by ``updates``."""
+        raise NotImplementedError
+
+    def finalize(self, buffer_refs: List[Expression]) -> Expression:
+        """Expression over the merged buffers producing the final value."""
+        assert len(buffer_refs) == 1
+        return buffer_refs[0]
+
+    @property
+    def tpu_supported(self) -> bool:
+        if self.child is None:
+            return True
+        if not self.child.tpu_supported:
+            return False
+        # string inputs: only order/presence aggregates work on device
+        if self.child.dtype.is_string:
+            return isinstance(self, (Min, Max, First, Last, Count))
+        return True
+
+    def sql(self):
+        c = self.child.sql() if self.child is not None else "*"
+        return f"{self.name}({c})"
+
+    def __repr__(self):  # pragma: no cover
+        return self.sql()
+
+
+class Count(AggregateFunction):
+    updates = [("count", 0)]
+    merges = ["sum"]
+
+    @property
+    def dtype(self):
+        return T.INT64
+
+    def buffer_dtypes(self):
+        return [T.INT64]
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Sum(AggregateFunction):
+    updates = [("sum", 0)]
+    merges = ["sum"]
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        if ct.is_floating:
+            return T.FLOAT64
+        return T.INT64
+
+    def buffer_dtypes(self):
+        return [self.dtype]
+
+
+class Min(AggregateFunction):
+    updates = [("min", 0)]
+    merges = ["min"]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def buffer_dtypes(self):
+        return [self.child.dtype]
+
+
+class Max(AggregateFunction):
+    updates = [("max", 0)]
+    merges = ["max"]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def buffer_dtypes(self):
+        return [self.child.dtype]
+
+
+class Average(AggregateFunction):
+    """sum + count composite (reference: GpuAverage:362 = CudfSum+CudfCount)."""
+
+    updates = [("sum", 0), ("count", 0)]
+    merges = ["sum", "sum"]
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def buffer_dtypes(self):
+        return [T.FLOAT64 if self.child.dtype.is_floating else T.INT64,
+                T.INT64]
+
+    def finalize(self, buffer_refs):
+        return Divide(buffer_refs[0], buffer_refs[1])
+
+
+class First(AggregateFunction):
+    updates = [("first", 0)]
+    merges = ["first"]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def buffer_dtypes(self):
+        return [self.child.dtype]
+
+
+class Last(AggregateFunction):
+    updates = [("last", 0)]
+    merges = ["last"]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def buffer_dtypes(self):
+        return [self.child.dtype]
+
+
+class AggregateExpression(Expression):
+    """Wrapper carrying (function, mode) through planning, mirroring the
+    reference's GpuAggregateExpression.  Not directly evaluable — the
+    aggregate exec interprets it."""
+
+    def __init__(self, func: AggregateFunction, mode: str = "complete"):
+        super().__init__(list(func.children))
+        self.func = func
+        self.mode = mode  # complete | partial | final
+
+    @property
+    def dtype(self):
+        return self.func.dtype
+
+    @property
+    def nullable(self):
+        return not isinstance(self.func, Count)
+
+    def sql(self):
+        return self.func.sql()

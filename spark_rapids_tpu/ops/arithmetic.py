@@ -1,0 +1,257 @@
+"""Arithmetic expressions.
+
+Capability parity with the reference's arithmetic.scala (Add/Subtract/
+Multiply/Divide/IntegralDivide/Remainder/Pmod/UnaryMinus/UnaryPositive/Abs).
+Semantics are Spark's (non-ANSI): integer overflow wraps (Java), division
+by zero yields NULL (all numeric types), integral division truncates toward
+zero (Java, not numpy floor), ``%`` takes the sign of the dividend.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .expression import BinaryExpression, UnaryExpression
+
+
+def _trunc_div_np(l, r):
+    """Java truncating division: floor division corrected toward zero.
+    (abs-based formulations overflow at INT64 min; this one doesn't.)"""
+    if np.issubdtype(l.dtype, np.integer):
+        safe = np.where(r == 0, 1, r)
+        q = l // safe
+        rem = l - q * safe
+        fix = (rem != 0) & ((l < 0) != (safe < 0))
+        return (q + fix.astype(l.dtype)).astype(l.dtype)
+    return np.trunc(l / np.where(r == 0, 1, r))
+
+
+def _trunc_div_jnp(l, r):
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(l.dtype, jnp.integer):
+        safe = jnp.where(r == 0, 1, r)
+        q = l // safe
+        rem = l - q * safe
+        fix = (rem != 0) & ((l < 0) != (safe < 0))
+        return (q + fix.astype(l.dtype)).astype(l.dtype)
+    return jnp.trunc(l / jnp.where(r == 0, 1, r))
+
+
+def _java_mod_np(l, r):
+    safe = np.where(r == 0, 1, r)
+    if np.issubdtype(l.dtype, np.floating):
+        return np.fmod(l, safe)
+    return (l - _trunc_div_np(l, safe) * safe).astype(l.dtype)
+
+
+def _java_mod_jnp(l, r):
+    import jax.numpy as jnp
+
+    safe = jnp.where(r == 0, 1, r)
+    if jnp.issubdtype(l.dtype, jnp.floating):
+        return jnp.fmod(l, safe)
+    return (l - _trunc_div_jnp(l, safe) * safe).astype(l.dtype)
+
+
+class Add(BinaryExpression):
+    def do_cpu(self, l, r):
+        return l + r
+
+    def do_tpu(self, l, r):
+        return l + r
+
+    def sql(self):
+        return f"({self.left.sql()} + {self.right.sql()})"
+
+
+class Subtract(BinaryExpression):
+    def do_cpu(self, l, r):
+        return l - r
+
+    def do_tpu(self, l, r):
+        return l - r
+
+    def sql(self):
+        return f"({self.left.sql()} - {self.right.sql()})"
+
+
+class Multiply(BinaryExpression):
+    def do_cpu(self, l, r):
+        return l * r
+
+    def do_tpu(self, l, r):
+        return l * r
+
+    def sql(self):
+        return f"({self.left.sql()} * {self.right.sql()})"
+
+
+class Divide(BinaryExpression):
+    """Fractional division; Spark returns double and NULL on zero divisor."""
+
+    def result_dtype(self, lt, rt):
+        return T.FLOAT64
+
+    def do_cpu(self, l, r):
+        return l / np.where(r == 0, 1, r)
+
+    def do_tpu(self, l, r):
+        import jax.numpy as jnp
+
+        return l / jnp.where(r == 0, 1, r)
+
+    def extra_null_cpu(self, l, r):
+        return r == 0
+
+    def extra_null_tpu(self, l, r):
+        return r == 0
+
+    def sql(self):
+        return f"({self.left.sql()} / {self.right.sql()})"
+
+
+class IntegralDivide(BinaryExpression):
+    def result_dtype(self, lt, rt):
+        return T.INT64
+
+    def _cast_inputs_np(self, l, r):
+        return l.astype(np.int64, copy=False), r.astype(np.int64, copy=False)
+
+    def _cast_inputs_jnp(self, l, r):
+        import jax.numpy as jnp
+
+        return l.astype(jnp.int64), r.astype(jnp.int64)
+
+    def do_cpu(self, l, r):
+        return _trunc_div_np(l, r)
+
+    def do_tpu(self, l, r):
+        return _trunc_div_jnp(l, r)
+
+    def extra_null_cpu(self, l, r):
+        return r == 0
+
+    def extra_null_tpu(self, l, r):
+        return r == 0
+
+
+class Remainder(BinaryExpression):
+    def do_cpu(self, l, r):
+        return _java_mod_np(l, r)
+
+    def do_tpu(self, l, r):
+        return _java_mod_jnp(l, r)
+
+    def extra_null_cpu(self, l, r):
+        return r == 0
+
+    def extra_null_tpu(self, l, r):
+        return r == 0
+
+    def sql(self):
+        return f"({self.left.sql()} % {self.right.sql()})"
+
+
+class Pmod(BinaryExpression):
+    def do_cpu(self, l, r):
+        safe = np.where(r == 0, 1, r)
+        m = _java_mod_np(l, safe)
+        return np.where((m != 0) & ((m < 0) != (safe < 0)), m + safe, m)
+
+    def do_tpu(self, l, r):
+        import jax.numpy as jnp
+
+        safe = jnp.where(r == 0, 1, r)
+        m = _java_mod_jnp(l, safe)
+        return jnp.where((m != 0) & ((m < 0) != (safe < 0)), m + safe, m)
+
+    def extra_null_cpu(self, l, r):
+        return r == 0
+
+    def extra_null_tpu(self, l, r):
+        return r == 0
+
+
+class UnaryMinus(UnaryExpression):
+    def do_cpu(self, data):
+        return -data
+
+    def do_tpu(self, data):
+        return -data
+
+    def sql(self):
+        return f"(- {self.child.sql()})"
+
+
+class UnaryPositive(UnaryExpression):
+    def do_cpu(self, data):
+        return data
+
+    def do_tpu(self, data):
+        return data
+
+
+class Abs(UnaryExpression):
+    def do_cpu(self, data):
+        return np.abs(data)
+
+    def do_tpu(self, data):
+        import jax.numpy as jnp
+
+        return jnp.abs(data)
+
+
+class _NullSkippingExtremum(BinaryExpression):
+    """Spark greatest/least: skip null inputs; result is null only when
+    ALL inputs are null.  NaN ranks greater than any value, so greatest
+    propagates NaN (maximum) and least ignores it (fmin)."""
+
+    np_fn = None
+    jnp_name = ""
+
+    def eval_cpu(self, batch):
+        from .expression import _and_validity_np, as_host_column
+
+        n = batch.num_rows
+        lc = as_host_column(self.left.eval_cpu(batch), n)
+        rc = as_host_column(self.right.eval_cpu(batch), n)
+        out_t = self.dtype
+        ld = lc.data.astype(out_t.np_dtype, copy=False)
+        rd = rc.data.astype(out_t.np_dtype, copy=False)
+        lv, rv = lc.is_valid(), rc.is_valid()
+        with np.errstate(all="ignore"):
+            both = type(self).np_fn(ld, rd)
+        data = np.where(lv & rv, both, np.where(lv, ld, rd))
+        validity = lv | rv
+        from ..data.column import HostColumn
+
+        return HostColumn(out_t, data,
+                          None if validity.all() else validity)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        from ..data.column import DeviceColumn
+        from .expression import as_device_column
+
+        n = batch.padded_rows
+        lc = as_device_column(self.left.eval_tpu(batch), n)
+        rc = as_device_column(self.right.eval_tpu(batch), n)
+        out_t = self.dtype
+        ld = lc.data.astype(out_t.jnp_dtype)
+        rd = rc.data.astype(out_t.jnp_dtype)
+        lv, rv = lc.validity, rc.validity
+        both = getattr(jnp, self.jnp_name)(ld, rd)
+        data = jnp.where(lv & rv, both, jnp.where(lv, ld, rd))
+        return DeviceColumn(out_t, data, lv | rv)
+
+
+class Least(_NullSkippingExtremum):
+    np_fn = staticmethod(np.fmin)   # NaN loses unless both NaN
+    jnp_name = "fmin"
+
+
+class Greatest(_NullSkippingExtremum):
+    np_fn = staticmethod(np.maximum)  # NaN wins (Spark: NaN > all)
+    jnp_name = "maximum"

@@ -1,0 +1,92 @@
+"""Bitwise and shift expressions.
+
+Capability parity with the reference's bitwise.scala: And/Or/Xor/Not/
+ShiftLeft/ShiftRight/ShiftRightUnsigned.  Shift distance is masked to the
+value's bit width (Java semantics).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .expression import BinaryExpression, UnaryExpression
+
+
+class BitwiseAnd(BinaryExpression):
+    def do_cpu(self, l, r):
+        return l & r
+
+    def do_tpu(self, l, r):
+        return l & r
+
+
+class BitwiseOr(BinaryExpression):
+    def do_cpu(self, l, r):
+        return l | r
+
+    def do_tpu(self, l, r):
+        return l | r
+
+
+class BitwiseXor(BinaryExpression):
+    def do_cpu(self, l, r):
+        return l ^ r
+
+    def do_tpu(self, l, r):
+        return l ^ r
+
+
+class BitwiseNot(UnaryExpression):
+    def do_cpu(self, data):
+        return ~data
+
+    def do_tpu(self, data):
+        return ~data
+
+
+def _shift_mask(dtype) -> int:
+    return 63 if np.dtype(dtype).itemsize == 8 else 31
+
+
+class _Shift(BinaryExpression):
+    def result_dtype(self, lt, rt):
+        return lt
+
+    def _cast_inputs_np(self, l, r):
+        return l, r.astype(np.int32, copy=False)
+
+    def _cast_inputs_jnp(self, l, r):
+        import jax.numpy as jnp
+
+        return l, r.astype(jnp.int32)
+
+
+class ShiftLeft(_Shift):
+    def do_cpu(self, l, r):
+        return l << (r & _shift_mask(l.dtype))
+
+    def do_tpu(self, l, r):
+        return l << (r & _shift_mask(l.dtype)).astype(l.dtype)
+
+
+class ShiftRight(_Shift):
+    def do_cpu(self, l, r):
+        return l >> (r & _shift_mask(l.dtype))
+
+    def do_tpu(self, l, r):
+        return l >> (r & _shift_mask(l.dtype)).astype(l.dtype)
+
+
+class ShiftRightUnsigned(_Shift):
+    def do_cpu(self, l, r):
+        shift = r & _shift_mask(l.dtype)
+        unsigned = l.astype(l.dtype).view(
+            np.uint64 if l.dtype.itemsize == 8 else np.uint32)
+        return (unsigned >> shift.astype(unsigned.dtype)).view(l.dtype)
+
+    def do_tpu(self, l, r):
+        import jax.numpy as jnp
+
+        shift = r & _shift_mask(l.dtype)
+        ut = jnp.uint64 if l.dtype.itemsize == 8 else jnp.uint32
+        return (l.view(ut) >> shift.astype(ut)).view(l.dtype)

@@ -1,0 +1,354 @@
+"""Cast expression — the full primitive cast matrix.
+
+Capability parity with the reference's GpuCast.scala (all primitive casts
+including string<->numeric/timestamp, with divergence-prone directions
+gated by confs exactly as the reference gates them:
+castStringToFloat/castFloatToString/castStringToTimestamp/
+castStringToInteger, RapidsConf.scala:373-403).
+
+Spark (non-ANSI) semantics implemented here:
+  * int -> narrower int: bit truncation (Java narrowing)
+  * float/double -> integral: NaN -> 0, out-of-range saturates (Java)
+  * numeric -> boolean: x != 0 ; boolean -> numeric: 0/1
+  * timestamp -> long/double: seconds since epoch; reverse multiplies
+  * date <-> timestamp: midnight UTC
+  * string -> numeric/date/timestamp: trimmed; invalid input -> NULL
+  * anything -> string: Spark's formatting (floats approximated, gated)
+
+Device path covers all non-string directions; string-involved casts run on
+the host engine via fallback tagging except string->string identity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import DeviceColumn, HostColumn
+from .expression import Expression, Scalar, as_host_column
+
+_INT_RANGE = {
+    T.TypeId.INT8: (-128, 127),
+    T.TypeId.INT16: (-(2 ** 15), 2 ** 15 - 1),
+    T.TypeId.INT32: (-(2 ** 31), 2 ** 31 - 1),
+    T.TypeId.INT64: (-(2 ** 63), 2 ** 63 - 1),
+}
+
+MICROS_PER_SEC = 1_000_000
+MICROS_PER_DAY = 86_400 * MICROS_PER_SEC
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: T.DType, ansi: bool = False):
+        super().__init__([child])
+        self.to = to
+        self.ansi = ansi
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self.to
+
+    @property
+    def nullable(self):
+        # string parses can produce nulls
+        return self.child.nullable or self.child.dtype.is_string
+
+    def sql(self):
+        return f"CAST({self.child.sql()} AS {self.to.sql_name})"
+
+    # ------------------------------------------------------------------
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        if isinstance(c, Scalar):
+            col = as_host_column(c, 1)
+            out = self._cast_host(col)
+            return Scalar(self.to, out[0])
+        return self._cast_host(c)
+
+    def _cast_host(self, col: HostColumn) -> HostColumn:
+        src, dst = col.dtype, self.to
+        if src == dst or src.id is T.TypeId.NULL:
+            if src.id is T.TypeId.NULL:
+                return HostColumn.nulls(col.num_rows, dst)
+            return col
+        data, extra_null = _host_cast(col.data, col.is_valid(), src, dst)
+        validity = col.validity
+        if extra_null is not None:
+            base = col.is_valid()
+            validity = base & ~extra_null
+        if validity is not None and bool(validity.all()):
+            validity = None
+        return HostColumn(dst, data, validity)
+
+    # ------------------------------------------------------------------
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        c = self.child.eval_tpu(batch)
+        if isinstance(c, Scalar):
+            host = as_host_column(c, 1)
+            out = self._cast_host(host)
+            return Scalar(self.to, out[0])
+        src, dst = c.dtype, self.to
+        if src == dst:
+            return c
+        data, extra_null = _device_cast(c.data, src, dst)
+        validity = c.validity if extra_null is None else c.validity & ~extra_null
+        return DeviceColumn(dst, data, validity)
+
+    @property
+    def tpu_supported(self):
+        # string-involved casts stay on the host engine (round 1)
+        return not (self.child.dtype.is_string or self.to.is_string)
+
+
+def _float_int_bounds(dst: T.DType):
+    """Float-representable clamp bounds: float(2**63-1) rounds UP to 2**63
+    which would overflow the int cast, so step down to the largest float
+    strictly below the bound."""
+    lo, hi = _INT_RANGE[dst.id]
+    lo_f, hi_f = float(lo), float(hi)
+    if hi_f > hi:
+        hi_f = float(np.nextafter(hi_f, 0.0))
+    return lo_f, hi_f
+
+
+def _sat_float_to_int(data: np.ndarray, dst: T.DType):
+    lo_f, hi_f = _float_int_bounds(dst)
+    d = np.where(np.isnan(data), 0.0, data)
+    d = np.clip(d, lo_f, hi_f)
+    return np.trunc(d).astype(dst.np_dtype)
+
+
+def _host_cast(data: np.ndarray, valid: np.ndarray, src: T.DType,
+               dst: T.DType):
+    """Returns (out_data, extra_null_mask_or_None)."""
+    sid, did = src.id, dst.id
+    # ---------- from string ----------
+    if src.is_string:
+        return _host_cast_from_string(data, valid, dst)
+    # ---------- to string ----------
+    if dst.is_string:
+        return _host_cast_to_string(data, valid, src), None
+    # ---------- boolean ----------
+    if sid is T.TypeId.BOOL:
+        return data.astype(dst.np_dtype), None
+    if did is T.TypeId.BOOL:
+        return (data != 0), None
+    # ---------- date/timestamp ----------
+    if sid is T.TypeId.DATE32:
+        if did is T.TypeId.TIMESTAMP:
+            return data.astype(np.int64) * MICROS_PER_DAY, None
+        return data.astype(dst.np_dtype), None
+    if sid is T.TypeId.TIMESTAMP:
+        if did is T.TypeId.DATE32:
+            return np.floor_divide(data, MICROS_PER_DAY).astype(np.int32), None
+        if did is T.TypeId.FLOAT64 or did is T.TypeId.FLOAT32:
+            return (data / MICROS_PER_SEC).astype(dst.np_dtype), None
+        # integral: seconds
+        return np.floor_divide(data, MICROS_PER_SEC).astype(
+            dst.np_dtype), None
+    if did is T.TypeId.TIMESTAMP:
+        if src.is_floating:
+            return (data.astype(np.float64) * MICROS_PER_SEC).astype(
+                np.int64), None
+        return data.astype(np.int64) * MICROS_PER_SEC, None
+    if did is T.TypeId.DATE32:
+        return data.astype(np.int32), None
+    # ---------- numeric -> numeric ----------
+    if src.is_floating and dst.is_integral:
+        return _sat_float_to_int(data, dst), None
+    return data.astype(dst.np_dtype), None
+
+
+def _host_cast_to_string(data, valid, src: T.DType) -> np.ndarray:
+    n = len(data)
+    out = np.empty(n, dtype=object)
+    sid = src.id
+    for i in range(n):
+        if not valid[i]:
+            out[i] = None
+            continue
+        v = data[i]
+        if sid is T.TypeId.BOOL:
+            out[i] = "true" if v else "false"
+        elif sid is T.TypeId.DATE32:
+            out[i] = str(np.datetime64(int(v), "D"))
+        elif sid is T.TypeId.TIMESTAMP:
+            ts = np.datetime64(int(v), "us")
+            s = str(ts).replace("T", " ")
+            out[i] = s
+        elif sid in (T.TypeId.FLOAT32, T.TypeId.FLOAT64):
+            f = float(v)
+            if np.isnan(f):
+                out[i] = "NaN"
+            elif np.isinf(f):
+                out[i] = "Infinity" if f > 0 else "-Infinity"
+            elif f == int(f) and abs(f) < 1e16:
+                out[i] = f"{f:.1f}"
+            else:
+                out[i] = repr(f)
+        else:
+            out[i] = str(int(v))
+    return out
+
+
+def _parse_num(s: str):
+    try:
+        return float(s)
+    except ValueError:
+        return None
+
+
+def _host_cast_from_string(data, valid, dst: T.DType):
+    n = len(data)
+    extra_null = np.zeros(n, dtype=np.bool_)
+    did = dst.id
+    if did is T.TypeId.BOOL:
+        out = np.zeros(n, dtype=np.bool_)
+        for i in range(n):
+            if not valid[i]:
+                continue
+            s = str(data[i]).strip().lower()
+            if s in ("t", "true", "y", "yes", "1"):
+                out[i] = True
+            elif s in ("f", "false", "n", "no", "0"):
+                out[i] = False
+            else:
+                extra_null[i] = True
+        return out, extra_null
+    if did is T.TypeId.DATE32:
+        out = np.zeros(n, dtype=np.int32)
+        for i in range(n):
+            if not valid[i]:
+                continue
+            try:
+                out[i] = np.datetime64(str(data[i]).strip(), "D").astype(
+                    np.int32)
+            except ValueError:
+                extra_null[i] = True
+        return out, extra_null
+    if did is T.TypeId.TIMESTAMP:
+        out = np.zeros(n, dtype=np.int64)
+        for i in range(n):
+            if not valid[i]:
+                continue
+            s = str(data[i]).strip().replace(" ", "T")
+            try:
+                out[i] = np.datetime64(s, "us").astype(np.int64)
+            except ValueError:
+                extra_null[i] = True
+        return out, extra_null
+    # numeric
+    out = np.zeros(n, dtype=dst.np_dtype)
+    for i in range(n):
+        if not valid[i]:
+            continue
+        s = str(data[i]).strip()
+        f = _parse_num(s) if s else None
+        if f is None:
+            extra_null[i] = True
+        elif dst.is_integral:
+            # Spark (non-ANSI) accepts decimal strings, truncating
+            # toward zero: '3.7' -> 3, '1e2' -> 100
+            if s.lstrip("+-").isdigit():
+                iv = int(s)
+            else:
+                iv = int(f) if abs(f) < 2 ** 63 else None
+            lo, hi = _INT_RANGE[did]
+            if iv is not None and lo <= iv <= hi:
+                out[i] = iv
+            else:
+                extra_null[i] = True
+        else:
+            out[i] = f
+    return out, extra_null
+
+
+def _device_cast(data, src: T.DType, dst: T.DType):
+    import jax.numpy as jnp
+
+    sid, did = src.id, dst.id
+    if sid is T.TypeId.BOOL:
+        return data.astype(dst.jnp_dtype), None
+    if did is T.TypeId.BOOL:
+        return data != 0, None
+    if sid is T.TypeId.DATE32:
+        if did is T.TypeId.TIMESTAMP:
+            return data.astype(jnp.int64) * MICROS_PER_DAY, None
+        return data.astype(dst.jnp_dtype), None
+    if sid is T.TypeId.TIMESTAMP:
+        if did is T.TypeId.DATE32:
+            return jnp.floor_divide(data, MICROS_PER_DAY).astype(
+                jnp.int32), None
+        if dst.is_floating:
+            return (data / MICROS_PER_SEC).astype(dst.jnp_dtype), None
+        return jnp.floor_divide(data, MICROS_PER_SEC).astype(
+            dst.jnp_dtype), None
+    if did is T.TypeId.TIMESTAMP:
+        if src.is_floating:
+            return (data.astype(jnp.float64) * MICROS_PER_SEC).astype(
+                jnp.int64), None
+        return data.astype(jnp.int64) * MICROS_PER_SEC, None
+    if did is T.TypeId.DATE32:
+        return data.astype(jnp.int32), None
+    if src.is_floating and dst.is_integral:
+        lo_f, hi_f = _float_int_bounds(dst)
+        d = jnp.where(jnp.isnan(data), 0.0, data)
+        d = jnp.clip(d, lo_f, hi_f)
+        return jnp.trunc(d).astype(dst.jnp_dtype), None
+    return data.astype(dst.jnp_dtype), None
+
+
+class NormalizeNaNAndZero(Expression):
+    """Reference: NormalizeFloatingNumbers.scala — canonicalize -0.0 to 0.0
+    and all NaN bit patterns to one NaN, so grouping/join keys compare."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        col = as_host_column(c, batch.num_rows)
+        d = col.data
+        d = np.where(d == 0.0, d.dtype.type(0.0), d)
+        d = np.where(np.isnan(d), d.dtype.type(np.nan), d)
+        return HostColumn(col.dtype, d, col.validity)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        from .expression import as_device_column
+
+        c = as_device_column(self.child.eval_tpu(batch), batch.padded_rows)
+        d = jnp.where(c.data == 0.0, jnp.zeros_like(c.data), c.data)
+        d = jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
+        return DeviceColumn(c.dtype, d, c.validity)
+
+
+class KnownFloatingPointNormalized(Expression):
+    """Pass-through marker (reference: constraintExpressions.scala)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval_cpu(self, batch):
+        return self.children[0].eval_cpu(batch)
+
+    def eval_tpu(self, batch):
+        return self.children[0].eval_tpu(batch)

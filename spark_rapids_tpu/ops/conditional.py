@@ -1,0 +1,145 @@
+"""Conditional expressions — If and CaseWhen.
+
+Capability parity with the reference's conditionalExpressions.scala, which
+lowers to cudf ``ifElse`` chains; here they lower to ``where`` selects on
+both engines (branch-free on device — all branches compute, masks select;
+this is the TPU-idiomatic form of the same chain).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import DeviceColumn, HostColumn
+from .expression import (
+    Expression,
+    Scalar,
+    as_device_column,
+    as_host_column,
+)
+
+
+def _common_type(dtypes):
+    out = None
+    for dt in dtypes:
+        if dt.id is T.TypeId.NULL:
+            continue
+        if out is None:
+            out = dt
+        elif out != dt:
+            out = T.promote(out, dt)
+    return out or T.NULL
+
+
+def _cast_np(data, src: T.DType, dst: T.DType):
+    if src == dst or dst.id is T.TypeId.NULL or src.id is T.TypeId.NULL:
+        return data
+    return data.astype(dst.np_dtype)
+
+
+class If(Expression):
+    def __init__(self, pred, if_true, if_false):
+        super().__init__([pred, if_true, if_false])
+
+    @property
+    def dtype(self):
+        return _common_type([self.children[1].dtype, self.children[2].dtype])
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        p = as_host_column(self.children[0].eval_cpu(batch), n)
+        t = as_host_column(self.children[1].eval_cpu(batch), n)
+        f = as_host_column(self.children[2].eval_cpu(batch), n)
+        cond = p.data.astype(np.bool_) & p.is_valid()
+        out = self.dtype
+        if out.is_string:
+            data = np.where(cond, t.data, f.data)
+        else:
+            data = np.where(cond, _cast_np(t.data, t.dtype, out),
+                            _cast_np(f.data, f.dtype, out))
+        validity = np.where(cond, t.is_valid(), f.is_valid())
+        return HostColumn(out, data,
+                          None if validity.all() else validity)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        p = as_device_column(self.children[0].eval_tpu(batch), n)
+        t = as_device_column(self.children[1].eval_tpu(batch), n)
+        f = as_device_column(self.children[2].eval_tpu(batch), n)
+        cond = p.data & p.validity
+        out = self.dtype
+        if out.is_string:
+            w = max(t.data.shape[1], f.data.shape[1])
+            from .kernels.stringkernels import _pad_to
+
+            data = jnp.where(cond[:, None], _pad_to(t.data, w),
+                             _pad_to(f.data, w))
+            lengths = jnp.where(cond, t.lengths, f.lengths)
+            validity = jnp.where(cond, t.validity, f.validity)
+            return DeviceColumn(out, data, validity, lengths)
+        td = t.data.astype(out.jnp_dtype) if t.dtype != out else t.data
+        fd = f.data.astype(out.jnp_dtype) if f.dtype != out else f.data
+        data = jnp.where(cond, td, fd)
+        validity = jnp.where(cond, t.validity, f.validity)
+        return DeviceColumn(out, data, validity)
+
+    def sql(self):
+        c = self.children
+        return f"IF({c[0].sql()}, {c[1].sql()}, {c[2].sql()})"
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... [ELSE e] END, desugared to an If chain."""
+
+    def __init__(self, branches: List[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        flat = []
+        for p, v in branches:
+            flat.extend([p, v])
+        if else_value is not None:
+            flat.append(else_value)
+        super().__init__(flat)
+        self.n_branches = len(branches)
+        self.has_else = else_value is not None
+
+    def _branches(self):
+        return [(self.children[2 * i], self.children[2 * i + 1])
+                for i in range(self.n_branches)]
+
+    def _else(self):
+        return self.children[-1] if self.has_else else None
+
+    def _chain(self) -> Expression:
+        from .expression import Literal
+
+        node: Expression = self._else() if self.has_else else Literal(
+            None, self._value_type())
+        for p, v in reversed(self._branches()):
+            node = If(p, v, node)
+        return node
+
+    def _value_type(self):
+        ts = [v.dtype for _, v in self._branches()]
+        if self.has_else:
+            ts.append(self._else().dtype)
+        return _common_type(ts)
+
+    @property
+    def dtype(self):
+        return self._value_type()
+
+    def eval_cpu(self, batch):
+        return self._chain().eval_cpu(batch)
+
+    def eval_tpu(self, batch):
+        return self._chain().eval_tpu(batch)
+
+    def sql(self):
+        parts = " ".join(f"WHEN {p.sql()} THEN {v.sql()}"
+                         for p, v in self._branches())
+        e = f" ELSE {self._else().sql()}" if self.has_else else ""
+        return f"CASE {parts}{e} END"

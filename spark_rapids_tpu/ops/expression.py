@@ -1,0 +1,480 @@
+"""Expression IR with dual columnar backends.
+
+Capability parity with the reference's L3 (GpuExpressions.scala:74-372):
+``columnarEval(batch)`` returning a column or scalar.  Here every expression
+implements BOTH engines:
+
+  * ``eval_cpu(HostBatch)``  — numpy; this IS the host engine (the CPU
+    oracle the equality harness compares against, and the fallback path
+    when an operator is tagged off the device).
+  * ``eval_tpu(DeviceBatch)`` — jax.numpy, called inside a ``jax.jit``
+    trace; one compiled XLA program per (plan, schema, row-bucket).
+
+Null semantics are Spark's: by default an output row is null when any input
+row is null (validity = AND of child validities); boolean AND/OR use Kleene
+logic; null-intolerant ops override ``eval_with_nulls``.
+
+TPU-first: invalid lanes still compute (branch-free, mask-carried), and all
+shapes are static — the padding rows of a bucketed batch flow through every
+expression with validity False.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import DeviceBatch, DeviceColumn, HostBatch, HostColumn
+
+
+class Scalar:
+    """A typed scalar result (cudf Scalar analogue); value None = null."""
+
+    __slots__ = ("dtype", "value")
+
+    def __init__(self, dtype: T.DType, value: Any):
+        self.dtype = dtype
+        self.value = value
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def __repr__(self):  # pragma: no cover
+        return f"Scalar({self.dtype}, {self.value})"
+
+
+ColumnLike = Union[HostColumn, Scalar]
+
+
+def as_host_column(x: ColumnLike, n: int) -> HostColumn:
+    if isinstance(x, HostColumn):
+        return x
+    if x.is_null:
+        return HostColumn.nulls(n, x.dtype)
+    if x.dtype.id is T.TypeId.STRING:
+        data = np.empty(n, dtype=object)
+        data[:] = x.value
+        return HostColumn(x.dtype, data)
+    return HostColumn(x.dtype,
+                      np.full(n, x.value, dtype=x.dtype.np_dtype))
+
+
+def as_device_column(x, n_padded: int) -> DeviceColumn:
+    import jax.numpy as jnp
+
+    if isinstance(x, DeviceColumn):
+        return x
+    assert isinstance(x, Scalar)
+    if x.dtype.id is T.TypeId.STRING:
+        from ..data import strings as dstrings
+
+        if x.is_null:
+            bm = np.zeros((1, 1), np.uint8)
+            ln = np.zeros(1, np.int32)
+        else:
+            bm, ln = dstrings.encode(np.array([x.value], object), None)
+        bm = jnp.broadcast_to(jnp.asarray(bm), (n_padded, bm.shape[1]))
+        ln = jnp.broadcast_to(jnp.asarray(ln), (n_padded,))
+        validity = jnp.full((n_padded,), not x.is_null, dtype=jnp.bool_)
+        return DeviceColumn(x.dtype, bm, validity, ln)
+    val = 0 if x.is_null else x.value
+    data = jnp.full((n_padded,), val, dtype=x.dtype.jnp_dtype)
+    validity = jnp.full((n_padded,), not x.is_null, dtype=jnp.bool_)
+    return DeviceColumn(x.dtype, data, validity)
+
+
+class Expression:
+    """Base expression node."""
+
+    def __init__(self, children: Sequence["Expression"] = ()):  # noqa: D401
+        self.children: List[Expression] = list(children)
+
+    # ----- static analysis --------------------------------------------------
+    @property
+    def dtype(self) -> T.DType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children) if self.children else True
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    @property
+    def deterministic(self) -> bool:
+        return all(c.deterministic for c in self.children)
+
+    @property
+    def has_input_file_intrinsic(self) -> bool:
+        return any(c.has_input_file_intrinsic for c in self.children)
+
+    def references(self) -> set:
+        out = set()
+        for c in self.children:
+            out |= c.references()
+        return out
+
+    def with_children(self, children: List["Expression"]) -> "Expression":
+        import copy
+
+        node = copy.copy(self)
+        node.children = list(children)
+        return node
+
+    def transform(self, fn) -> "Expression":
+        node = self.with_children([c.transform(fn) for c in self.children])
+        replaced = fn(node)
+        return node if replaced is None else replaced
+
+    # ----- evaluation -------------------------------------------------------
+    def eval_cpu(self, batch: HostBatch) -> ColumnLike:
+        raise NotImplementedError(f"{self.name}.eval_cpu")
+
+    def eval_tpu(self, batch: DeviceBatch):
+        """Traced device evaluation; must be overridden by device-capable
+        expressions.  Expressions lacking this are tagged off the device by
+        the plan-rewrite engine (transparent host fallback)."""
+        raise NotImplementedError(f"{self.name}.eval_tpu")
+
+    @property
+    def tpu_supported(self) -> bool:
+        return type(self).eval_tpu is not Expression.eval_tpu
+
+    def sql(self) -> str:
+        return f"{self.name}({', '.join(c.sql() for c in self.children)})"
+
+    def __repr__(self):  # pragma: no cover
+        return self.sql()
+
+
+# --------------------------------------------------------------------------
+# Leaves
+# --------------------------------------------------------------------------
+class Literal(Expression):
+    """Reference analogue: literals.scala GpuLiteral -> cudf Scalar."""
+
+    def __init__(self, value: Any, dtype: Optional[T.DType] = None):
+        super().__init__()
+        if dtype is None:
+            dtype = _infer_literal_type(value)
+        self._dtype = dtype
+        self.value = value
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def eval_cpu(self, batch):
+        return Scalar(self._dtype, self.value)
+
+    def eval_tpu(self, batch):
+        return Scalar(self._dtype, self.value)
+
+    def sql(self):
+        return repr(self.value)
+
+
+def _infer_literal_type(v) -> T.DType:
+    if v is None:
+        return T.NULL
+    if isinstance(v, bool):
+        return T.BOOL
+    if isinstance(v, (int, np.integer)):
+        return T.INT32 if -(2 ** 31) <= int(v) < 2 ** 31 else T.INT64
+    if isinstance(v, (float, np.floating)):
+        return T.FLOAT64
+    if isinstance(v, str):
+        return T.STRING
+    raise TypeError(f"cannot infer literal type for {v!r}")
+
+
+def lit(v, dtype=None) -> Literal:
+    return v if isinstance(v, Expression) else Literal(v, dtype)
+
+
+class UnresolvedAttribute(Expression):
+    def __init__(self, attr_name: str):
+        super().__init__()
+        self.attr_name = attr_name
+
+    @property
+    def dtype(self):
+        raise ValueError(f"unresolved attribute '{self.attr_name}'")
+
+    def references(self):
+        return {self.attr_name}
+
+    def eval_cpu(self, batch):
+        raise ValueError(f"unresolved attribute '{self.attr_name}'")
+
+    def sql(self):
+        return self.attr_name
+
+
+class BoundReference(Expression):
+    """Reference analogue: GpuBoundReference
+    (GpuBoundAttribute.scala — bindReferences binds attrs to ordinals)."""
+
+    def __init__(self, ordinal: int, dtype: T.DType, nullable: bool = True,
+                 attr_name: str = ""):
+        super().__init__()
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+        self.attr_name = attr_name
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def eval_cpu(self, batch: HostBatch):
+        return batch.columns[self.ordinal]
+
+    def eval_tpu(self, batch: DeviceBatch):
+        return batch.columns[self.ordinal]
+
+    def sql(self):
+        return self.attr_name or f"input[{self.ordinal}]"
+
+
+class Alias(Expression):
+    """Reference analogue: namedExpressions.scala GpuAlias."""
+
+    def __init__(self, child: Expression, alias: str):
+        super().__init__([child])
+        self.alias = alias
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    def eval_cpu(self, batch):
+        return self.child.eval_cpu(batch)
+
+    def eval_tpu(self, batch):
+        return self.child.eval_tpu(batch)
+
+    def sql(self):
+        return f"{self.child.sql()} AS {self.alias}"
+
+
+def output_name(expr: Expression, i: int) -> str:
+    if isinstance(expr, Alias):
+        return expr.alias
+    if isinstance(expr, (UnresolvedAttribute,)):
+        return expr.attr_name
+    if isinstance(expr, BoundReference) and expr.attr_name:
+        return expr.attr_name
+    return f"col{i}"
+
+
+def bind_references(expr: Expression, schema: T.Schema) -> Expression:
+    """Reference analogue: GpuBindReferences.bindReferences."""
+
+    def replace(node):
+        if isinstance(node, UnresolvedAttribute):
+            idx = schema.index_of(node.attr_name)
+            f = schema[idx]
+            return BoundReference(idx, f.dtype, f.nullable, node.attr_name)
+        return None
+
+    return expr.transform(replace)
+
+
+# --------------------------------------------------------------------------
+# Generic unary/binary machinery
+# (reference: GpuUnaryExpression/GpuBinaryExpression/CudfUnaryExpression/
+#  CudfBinaryExpression, GpuExpressions.scala:101-372)
+# --------------------------------------------------------------------------
+def _and_validity_np(n, *cols):
+    v = None
+    for c in cols:
+        if isinstance(c, HostColumn):
+            cv = c.validity
+        else:  # Scalar
+            cv = None if not c.is_null else np.zeros(n, dtype=np.bool_)
+        if cv is not None:
+            v = cv if v is None else (v & cv)
+    return v
+
+
+def _and_validity_jnp(n, *cols):
+    import jax.numpy as jnp
+
+    v = None
+    for c in cols:
+        if isinstance(c, DeviceColumn):
+            cv = c.validity
+        else:
+            cv = None if not c.is_null else jnp.zeros(n, dtype=jnp.bool_)
+        if cv is not None:
+            v = cv if v is None else (v & cv)
+    if v is None:
+        v = jnp.ones(n, dtype=jnp.bool_)
+    return v
+
+
+class UnaryExpression(Expression):
+    """Null-intolerant unary op: override do_cpu(data)->data and
+    do_tpu(data)->data; validity passes through."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self.result_dtype(self.child.dtype)
+
+    def result_dtype(self, child_dtype: T.DType) -> T.DType:
+        return child_dtype
+
+    # override points
+    def do_cpu(self, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def do_tpu(self, data):
+        raise NotImplementedError
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        if isinstance(c, Scalar):
+            if c.is_null:
+                return Scalar(self.dtype, None)
+            arr = np.asarray([c.value], dtype=c.dtype.np_dtype)
+            return Scalar(self.dtype, self.do_cpu(arr)[0].item())
+        with np.errstate(all="ignore"):
+            data = self.do_cpu(c.data)
+        return HostColumn(self.dtype, data, c.validity)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        c = as_device_column(c, batch.padded_rows)
+        return DeviceColumn(self.dtype, self.do_tpu(c.data), c.validity)
+
+
+class BinaryExpression(Expression):
+    """Null-intolerant binary op."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def dtype(self):
+        return self.result_dtype(self.left.dtype, self.right.dtype)
+
+    def result_dtype(self, lt: T.DType, rt: T.DType) -> T.DType:
+        return T.promote(lt, rt)
+
+    def do_cpu(self, l: np.ndarray, r: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def do_tpu(self, l, r):
+        raise NotImplementedError
+
+    # hook: validity beyond AND-of-inputs (e.g. division by zero -> null)
+    def extra_null_cpu(self, l, r):
+        return None
+
+    def extra_null_tpu(self, l, r):
+        return None
+
+    def _cast_inputs_np(self, l, r):
+        out = self.dtype
+        if out.is_numeric:
+            return (l.astype(out.np_dtype, copy=False),
+                    r.astype(out.np_dtype, copy=False))
+        lt, rt = self.left.dtype, self.right.dtype
+        if lt.is_numeric and rt.is_numeric:
+            p = T.promote(lt, rt)
+            return (l.astype(p.np_dtype, copy=False),
+                    r.astype(p.np_dtype, copy=False))
+        return l, r
+
+    def _cast_inputs_jnp(self, l, r):
+        out = self.dtype
+        if out.is_numeric:
+            return l.astype(out.jnp_dtype), r.astype(out.jnp_dtype)
+        lt, rt = self.left.dtype, self.right.dtype
+        if lt.is_numeric and rt.is_numeric:
+            p = T.promote(lt, rt)
+            return l.astype(p.jnp_dtype), r.astype(p.jnp_dtype)
+        return l, r
+
+    def eval_cpu(self, batch):
+        lc = self.left.eval_cpu(batch)
+        rc = self.right.eval_cpu(batch)
+        if isinstance(lc, Scalar) and isinstance(rc, Scalar):
+            if lc.is_null or rc.is_null:
+                return Scalar(self.dtype, None)
+            lc = as_host_column(lc, 1)
+            rc = as_host_column(rc, 1)
+            l, r = self._cast_inputs_np(lc.data, rc.data)
+            with np.errstate(all="ignore"):
+                out = self.do_cpu(l, r)
+            extra = self.extra_null_cpu(l, r)
+            if extra is not None and bool(extra[0]):
+                return Scalar(self.dtype, None)
+            return Scalar(self.dtype, out[0].item()
+                          if hasattr(out[0], "item") else out[0])
+        n = batch.num_rows
+        lcol = as_host_column(lc, n)
+        rcol = as_host_column(rc, n)
+        validity = _and_validity_np(n, lc, rc)
+        l, r = self._cast_inputs_np(lcol.data, rcol.data)
+        with np.errstate(all="ignore"):
+            data = self.do_cpu(l, r)
+        extra = self.extra_null_cpu(l, r)
+        if extra is not None:
+            validity = (~extra) if validity is None else (validity & ~extra)
+        return HostColumn(self.dtype, data, validity)
+
+    def eval_tpu(self, batch):
+        n = batch.padded_rows
+        lc = self.left.eval_tpu(batch)
+        rc = self.right.eval_tpu(batch)
+        lcol = as_device_column(lc, n)
+        rcol = as_device_column(rc, n)
+        validity = _and_validity_jnp(n, lc, rc)
+        l, r = self._cast_inputs_jnp(lcol.data, rcol.data)
+        data = self.do_tpu(l, r)
+        extra = self.extra_null_tpu(l, r)
+        if extra is not None:
+            validity = validity & ~extra
+        return DeviceColumn(self.dtype, data, validity)
+
+
+class TernaryExpression(Expression):
+    def __init__(self, a, b, c):
+        super().__init__([a, b, c])

@@ -1,0 +1,43 @@
+"""Device gather/compaction kernels.
+
+Row selection (filter, sort, join output) on TPU is expressed as
+stable-sort + gather over static shapes: a boolean keep-mask becomes a
+permutation that compacts kept rows to the front, with the logical row
+count carried as a traced scalar — no dynamic shapes, no recompiles.
+(Reference analogue: cudf Table.filter / gather; SURVEY §7 Hard parts.)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...data.column import DeviceBatch, DeviceColumn
+
+
+def gather_column(col: DeviceColumn, order, valid_mask=None) -> DeviceColumn:
+    """Permute one column by ``order`` (int32[n]); optionally AND the
+    permuted validity with ``valid_mask`` (already in output order)."""
+    data = col.data[order]
+    validity = col.validity[order]
+    if valid_mask is not None:
+        validity = validity & valid_mask
+    lengths = col.lengths[order] if col.lengths is not None else None
+    return DeviceColumn(col.dtype, data, validity, lengths)
+
+
+def gather_batch(batch: DeviceBatch, order, num_rows,
+                 valid_mask=None) -> DeviceBatch:
+    cols = [gather_column(c, order, valid_mask) for c in batch.columns]
+    return DeviceBatch(batch.schema, cols, num_rows)
+
+
+def compact(batch: DeviceBatch, keep) -> DeviceBatch:
+    """Compact rows where ``keep`` (bool[padded]) to the front; the new
+    logical row count is sum(keep).  Stable."""
+    import jax.numpy as jnp
+
+    keep = keep & batch.row_mask()
+    # stable argsort of (not keep): kept rows (0) first, original order
+    order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    count = keep.sum().astype(jnp.int32)
+    kept_mask = jnp.arange(batch.padded_rows, dtype=jnp.int32) < count
+    return gather_batch(batch, order, count, kept_mask)

@@ -1,0 +1,343 @@
+"""Segment-reduction kernels — the TPU group-by engine.
+
+The reference lowers group-by to cudf's hash-based groupBy.aggregate
+(aggregate.scala:360-388).  Hash tables scatter randomly, which is hostile
+to the TPU memory model, so the device implementation here is sort-based:
+sort rows by key, derive segment ids at key-change boundaries, then
+``jax.ops.segment_*`` reductions — exactly the "sort + segment-reduce"
+design called out in SURVEY §7 Hard parts.
+
+Both engines share the same structure: the host (numpy) versions use
+argsort + np.*.reduceat; the device versions use stable sort + segment ops
+with a static ``num_segments`` (the row bucket), so shapes stay static.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...data.column import DeviceColumn, HostColumn
+
+# ---------------------------------------------------------------------------
+# Host (numpy) engine
+# ---------------------------------------------------------------------------
+
+
+def _null_key_np(col: HostColumn):
+    """Sortable key array where nulls order first and floats canonicalize."""
+    if col.dtype.is_string:
+        data = np.asarray([x if isinstance(x, str) else "" for x in col.data],
+                          dtype=object)
+    else:
+        data = col.data
+        if col.dtype.is_floating:
+            data = np.where(data == 0.0, data.dtype.type(0.0), data)
+    return data, ~col.is_valid()
+
+
+def _uint64_key_np(col: HostColumn) -> np.ndarray:
+    """Order-preserving uint64 encoding of a non-string column
+    (floats via sign-magnitude bit flip; NaN > +inf, Spark order)."""
+    tid = col.dtype.id
+    data = col.data
+    if tid is T.TypeId.BOOL:
+        return data.astype(np.uint64)
+    if col.dtype.is_floating:
+        d = data.astype(np.float64)
+        d = np.where(d == 0.0, 0.0, d)
+        bits = d.view(np.int64)
+        flipped = np.where(bits < 0, ~bits, bits ^ np.int64(-2 ** 63))
+        u = flipped.view(np.uint64)
+        return np.where(np.isnan(d), np.uint64(0xFFFFFFFFFFFFFFFE), u)
+    return (data.astype(np.int64) ^ np.int64(-2 ** 63)).view(np.uint64)
+
+
+def lexsort_np(key_cols: List[HostColumn],
+               descending: List[bool] = None,
+               nulls_first: List[bool] = None) -> np.ndarray:
+    """Stable multi-key argsort; nulls first by default (Spark ASC).
+    Same pass structure as the device lexsort so orderings agree."""
+    n = key_cols[0].num_rows if key_cols else 0
+    if descending is None:
+        descending = [False] * len(key_cols)
+    if nulls_first is None:
+        nulls_first = [True] * len(key_cols)
+    passes = []  # passes[0] dominates
+    for col, desc, nf in zip(key_cols, descending, nulls_first):
+        is_null = ~col.is_valid()
+        null_rank = 0 if nf else 1
+        passes.append(np.where(is_null, np.uint64(null_rank),
+                               np.uint64(1 - null_rank)))
+        if col.dtype.is_string:
+            s = np.asarray([x if isinstance(x, str) else ""
+                            for x in col.data], dtype=object)
+            # rank-encode via unique (binary collation of python str
+            # matches UTF-8 byte order for the BMP subset we support)
+            uniq, inv = np.unique(s.astype(str), return_inverse=True)
+            k = inv.astype(np.uint64)
+        else:
+            k = _uint64_key_np(col)
+        if desc:
+            k = ~k
+        passes.append(np.where(is_null, np.uint64(0), k))
+    order = np.arange(n)
+    for k in reversed(passes):
+        order = order[np.argsort(k[order], kind="stable")]
+    return order
+
+
+def group_segments_np(key_cols: List[HostColumn]
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sort by keys; return (sorted_order, segment_id_per_sorted_row,
+    segment_start_indices)."""
+    n = key_cols[0].num_rows
+    order = lexsort_np(key_cols)
+    change = np.zeros(n, dtype=np.bool_)
+    if n:
+        change[0] = True
+    for col in key_cols:
+        data, is_null = _null_key_np(col)
+        d = data[order]
+        nl = is_null[order]
+        if n > 1:
+            neq = np.zeros(n, dtype=np.bool_)
+            if col.dtype.is_string:
+                for i in range(1, n):
+                    neq[i] = (d[i] != d[i - 1]) or (nl[i] != nl[i - 1])
+            else:
+                neq[1:] = (d[1:] != d[:-1]) | (nl[1:] != nl[:-1])
+                if col.dtype.is_floating:
+                    both_nan = np.zeros(n, dtype=np.bool_)
+                    both_nan[1:] = np.isnan(d[1:].astype(np.float64)) & \
+                        np.isnan(d[:-1].astype(np.float64))
+                    neq[1:] &= ~both_nan[1:]
+            change |= neq
+    seg_ids = np.cumsum(change) - 1 if n else np.zeros(0, dtype=np.int64)
+    seg_starts = np.nonzero(change)[0]
+    return order, seg_ids.astype(np.int64), seg_starts
+
+
+_NP_REDUCE = {
+    "sum": np.add,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def segment_reduce_np(values: np.ndarray, valid: np.ndarray,
+                      seg_ids: np.ndarray, n_segments: int, op: str):
+    """Reduce ``values`` per segment, ignoring invalid rows.
+    Returns (out_values, out_valid)."""
+    counts = np.zeros(n_segments, dtype=np.int64)
+    np.add.at(counts, seg_ids, valid.astype(np.int64))
+    if op == "count":
+        return counts, np.ones(n_segments, dtype=np.bool_)
+    if op in ("first", "last"):
+        idx = np.arange(len(values))
+        big = len(values) + 1
+        key = np.where(valid, idx, big if op == "first" else -1)
+        pick = np.full(n_segments, big if op == "first" else -1,
+                       dtype=np.int64)
+        red = np.minimum if op == "first" else np.maximum
+        red.at(pick, seg_ids, key)
+        ok = counts > 0
+        safe = np.clip(pick, 0, len(values) - 1)
+        return values[safe.astype(np.int64)], ok
+    if op == "sum":
+        if values.dtype == object:
+            raise TypeError("sum of strings")
+        acc_t = np.float64 if np.issubdtype(values.dtype, np.floating) \
+            else np.int64
+        acc = np.zeros(n_segments, dtype=acc_t)
+        np.add.at(acc, seg_ids, np.where(valid, values, 0).astype(acc_t))
+        return acc, counts > 0
+    if op in ("min", "max"):
+        if values.dtype == object:  # strings: python reduce per segment
+            out = np.empty(n_segments, dtype=object)
+            ok = counts > 0
+            fn = min if op == "min" else max
+            for s in range(n_segments):
+                vals = [v for v, vl in zip(values[seg_ids == s],
+                                           valid[seg_ids == s]) if vl]
+                out[s] = fn(vals) if vals else None
+            return out, ok
+        if np.issubdtype(values.dtype, np.floating):
+            init = np.inf if op == "min" else -np.inf
+            acc = np.full(n_segments, init, dtype=values.dtype)
+            fill = init
+        else:
+            info = np.iinfo(values.dtype)
+            fill = info.max if op == "min" else info.min
+            acc = np.full(n_segments, fill, dtype=values.dtype)
+        red = _NP_REDUCE[op]
+        red.at(acc, seg_ids, np.where(valid, values,
+                                      values.dtype.type(fill)))
+        return acc, counts > 0
+    raise ValueError(op)
+
+
+# ---------------------------------------------------------------------------
+# Device (jnp) engine
+# ---------------------------------------------------------------------------
+def _sort_key_device(col: DeviceColumn, desc: bool, nulls_first: bool):
+    """Build orderable uint64 key(s) for one device column.
+
+    Numerics map order-preservingly into uint64; nulls get the extreme
+    value for their placement; strings contribute one key per byte chunk
+    (handled by caller via multiple passes)."""
+    import jax.numpy as jnp
+
+    tid = col.dtype.id
+    if col.dtype.is_string:
+        raise AssertionError("string keys handled via chunked passes")
+    data = col.data
+    if tid is T.TypeId.BOOL:
+        u = data.astype(jnp.uint64)
+    elif col.dtype.is_floating:
+        d = data.astype(jnp.float64) if tid is T.TypeId.FLOAT64 \
+            else data.astype(jnp.float32)
+        d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+        if tid is T.TypeId.FLOAT64:
+            bits = d.view(jnp.int64)
+            sign = bits < 0
+            flipped = jnp.where(sign, ~bits, bits ^ jnp.int64(-2 ** 63))
+            u = flipped.view(jnp.uint64)
+        else:
+            bits = d.view(jnp.int32)
+            sign = bits < 0
+            flipped = jnp.where(sign, ~bits, bits ^ jnp.int32(-2 ** 31))
+            u = flipped.view(jnp.uint32).astype(jnp.uint64)
+        # NaN sorts last among valids (Spark: NaN > all doubles)
+        nan = jnp.isnan(d)
+        u = jnp.where(nan, jnp.uint64(0xFFFFFFFFFFFFFFFE), u)
+    else:
+        u = (data.astype(jnp.int64) ^ jnp.int64(-2 ** 63)).view(jnp.uint64)
+    if desc:
+        u = ~u
+    # nulls are placed by a separate dominating pass in lexsort_device;
+    # here they just need a deterministic value
+    u = jnp.where(col.validity, u, jnp.uint64(0))
+    return u
+
+
+def lexsort_device(key_cols: List[DeviceColumn],
+                   descending: List[bool] = None,
+                   nulls_first: List[bool] = None,
+                   pad_valid=None):
+    """Stable multi-key argsort on device.  Padding rows (pad_valid False)
+    always sort last.  Returns int32 permutation."""
+    import jax.numpy as jnp
+
+    n = key_cols[0].data.shape[0]
+    if descending is None:
+        descending = [False] * len(key_cols)
+    if nulls_first is None:
+        nulls_first = [True] * len(key_cols)
+    order = jnp.arange(n, dtype=jnp.int32)
+    passes = []  # uint64 key passes; passes[0] dominates (applied last)
+    for col, desc, nf in zip(key_cols, descending, nulls_first):
+        # null-placement pass dominates this column's value passes
+        null_rank = jnp.uint64(0) if nf else jnp.uint64(1)
+        valid_rank = jnp.uint64(1) - null_rank
+        passes.append(jnp.where(col.validity, valid_rank, null_rank))
+        if col.dtype.is_string:
+            w = col.data.shape[1]
+            # chunk 8 bytes per uint64 pass (MSB-first ordering)
+            for start in range(0, w, 8):
+                chunk = col.data[:, start:start + 8]
+                cw = chunk.shape[1]
+                k = jnp.zeros((n,), dtype=jnp.uint64)
+                for b in range(cw):
+                    k = (k << jnp.uint64(8)) | chunk[:, b].astype(jnp.uint64)
+                k = k << jnp.uint64(8 * (8 - cw))
+                if desc:
+                    k = ~k
+                k = jnp.where(col.validity, k, jnp.uint64(0))
+                passes.append(k)
+        else:
+            passes.append(_sort_key_device(col, desc, nf))
+    if pad_valid is not None:
+        passes.insert(0, jnp.where(pad_valid, jnp.uint64(0),
+                                   jnp.uint64(2 ** 64 - 1)))
+    for k in reversed(passes):
+        order = order[jnp.argsort(k[order], stable=True)]
+    return order
+
+
+def segment_ids_device(sorted_keys: List[DeviceColumn], pad_valid=None):
+    """Given key columns already in sorted order, derive segment ids by
+    key-change boundaries.  Returns int32 segment ids (padding rows get
+    their own trailing segments beyond the real ones)."""
+    import jax.numpy as jnp
+
+    n = sorted_keys[0].data.shape[0] if sorted_keys else (
+        pad_valid.shape[0] if pad_valid is not None else 0)
+    change = jnp.zeros((n,), dtype=jnp.bool_).at[0].set(True)
+    for col in sorted_keys:
+        v = col.validity
+        if col.dtype.is_string:
+            d = col.data
+            neq = jnp.zeros((n,), dtype=jnp.bool_)
+            neq = neq.at[1:].set((d[1:] != d[:-1]).any(axis=1)
+                                 | (col.lengths[1:] != col.lengths[:-1])
+                                 | (v[1:] != v[:-1]))
+        else:
+            d = col.data
+            if col.dtype.is_floating:
+                d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+                both_nan = jnp.zeros((n,), dtype=jnp.bool_)
+                both_nan = both_nan.at[1:].set(jnp.isnan(d[1:])
+                                               & jnp.isnan(d[:-1]))
+                neq = jnp.zeros((n,), dtype=jnp.bool_)
+                neq = neq.at[1:].set(((d[1:] != d[:-1]) & ~both_nan[1:])
+                                     | (v[1:] != v[:-1]))
+            else:
+                neq = jnp.zeros((n,), dtype=jnp.bool_)
+                neq = neq.at[1:].set((d[1:] != d[:-1]) | (v[1:] != v[:-1]))
+        change = change | neq
+    if pad_valid is not None:
+        # every padding row becomes its own segment so it never merges
+        change = change | ~pad_valid
+    return (jnp.cumsum(change.astype(jnp.int32)) - 1).astype(jnp.int32)
+
+
+def segment_reduce_device(values, valid, seg_ids, n_segments: int, op: str):
+    """Device segment reduction; returns (out_values, out_valid) with
+    ``n_segments`` static (row bucket)."""
+    import jax
+    import jax.numpy as jnp
+
+    counts = jax.ops.segment_sum(valid.astype(jnp.int64), seg_ids,
+                                 num_segments=n_segments)
+    ok = counts > 0
+    if op == "count":
+        return counts, jnp.ones((n_segments,), dtype=jnp.bool_)
+    if op == "sum":
+        acc_t = jnp.float64 if jnp.issubdtype(values.dtype, jnp.floating) \
+            else jnp.int64
+        acc = jax.ops.segment_sum(
+            jnp.where(valid, values, 0).astype(acc_t), seg_ids,
+            num_segments=n_segments)
+        return acc, ok
+    if op == "min" or op == "max":
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            fill = jnp.inf if op == "min" else -jnp.inf
+        else:
+            info = jnp.iinfo(values.dtype)
+            fill = info.max if op == "min" else info.min
+        masked = jnp.where(valid, values, jnp.asarray(fill, values.dtype))
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        acc = fn(masked, seg_ids, num_segments=n_segments)
+        return acc, ok
+    if op in ("first", "last"):
+        n = values.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        big = n + 1
+        key = jnp.where(valid, idx, big if op == "first" else -1)
+        fn = jax.ops.segment_min if op == "first" else jax.ops.segment_max
+        pick = fn(key, seg_ids, num_segments=n_segments)
+        safe = jnp.clip(pick, 0, n - 1).astype(jnp.int32)
+        return values[safe], ok
+    raise ValueError(op)

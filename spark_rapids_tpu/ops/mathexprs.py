@@ -1,0 +1,162 @@
+"""Math expressions.
+
+Capability parity with the reference's mathExpressions.scala: trig, log,
+exp, sqrt, cbrt, rint, signum, floor, ceil, pow and friends.  Most Spark
+math functions operate in double; floor/ceil of integrals stay integral.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .expression import BinaryExpression, UnaryExpression
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class _DoubleUnary(UnaryExpression):
+    """Unary math op computing in double (Spark semantics)."""
+
+    np_fn = None
+    jnp_name = None
+
+    def result_dtype(self, ct):
+        return T.FLOAT64
+
+    def do_cpu(self, data):
+        return type(self).np_fn(data.astype(np.float64))
+
+    def do_tpu(self, data):
+        jnp = _jnp()
+        fn = getattr(jnp, self.jnp_name)
+        return fn(data.astype(jnp.float64))
+
+
+def _double_unary(name, np_fn, jnp_name):
+    cls = type(name, (_DoubleUnary,), {"np_fn": staticmethod(np_fn),
+                                       "jnp_name": jnp_name})
+    globals()[name] = cls
+    return cls
+
+
+Acos = _double_unary("Acos", np.arccos, "arccos")
+Asin = _double_unary("Asin", np.arcsin, "arcsin")
+Atan = _double_unary("Atan", np.arctan, "arctan")
+Cos = _double_unary("Cos", np.cos, "cos")
+Sin = _double_unary("Sin", np.sin, "sin")
+Tan = _double_unary("Tan", np.tan, "tan")
+Cosh = _double_unary("Cosh", np.cosh, "cosh")
+Sinh = _double_unary("Sinh", np.sinh, "sinh")
+Tanh = _double_unary("Tanh", np.tanh, "tanh")
+Exp = _double_unary("Exp", np.exp, "exp")
+Expm1 = _double_unary("Expm1", np.expm1, "expm1")
+Log = _double_unary("Log", np.log, "log")
+Log1p = _double_unary("Log1p", np.log1p, "log1p")
+Log2 = _double_unary("Log2", np.log2, "log2")
+Log10 = _double_unary("Log10", np.log10, "log10")
+Sqrt = _double_unary("Sqrt", np.sqrt, "sqrt")
+Cbrt = _double_unary("Cbrt", np.cbrt, "cbrt")
+Rint = _double_unary("Rint", np.rint, "rint")
+
+
+class Signum(UnaryExpression):
+    def result_dtype(self, ct):
+        return T.FLOAT64
+
+    def do_cpu(self, data):
+        return np.sign(data.astype(np.float64))
+
+    def do_tpu(self, data):
+        jnp = _jnp()
+        return jnp.sign(data.astype(jnp.float64))
+
+
+_LONG_HI_F = float(np.nextafter(float(2 ** 63 - 1), 0.0))
+
+
+def _sat_to_long_np(d):
+    """Saturating double->long (Java (long) cast semantics).  The clamp
+    upper bound must be float-representable BELOW 2**63."""
+    d = np.where(np.isnan(d), 0.0, d)
+    d = np.clip(d, float(-2 ** 63), _LONG_HI_F)
+    out = d.astype(np.int64)
+    # values clamped to the float bound still mean Long.MAX_VALUE
+    return np.where(d >= _LONG_HI_F, np.int64(2 ** 63 - 1), out)
+
+
+class Floor(UnaryExpression):
+    """Spark floor returns LONG for fractional input (saturating cast)."""
+
+    def result_dtype(self, ct):
+        return T.INT64 if ct.is_floating else ct
+
+    def do_cpu(self, data):
+        if np.issubdtype(data.dtype, np.integer):
+            return data
+        return _sat_to_long_np(np.floor(data))
+
+    def do_tpu(self, data):
+        jnp = _jnp()
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            return data
+        d = jnp.floor(data)
+        d = jnp.where(jnp.isnan(d), 0.0, d)
+        d = jnp.clip(d, float(-2 ** 63), float(2 ** 63 - 1))
+        return d.astype(jnp.int64)
+
+
+class Ceil(UnaryExpression):
+    def result_dtype(self, ct):
+        return T.INT64 if ct.is_floating else ct
+
+    def do_cpu(self, data):
+        if np.issubdtype(data.dtype, np.integer):
+            return data
+        return _sat_to_long_np(np.ceil(data))
+
+    def do_tpu(self, data):
+        jnp = _jnp()
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            return data
+        d = jnp.ceil(data)
+        d = jnp.where(jnp.isnan(d), 0.0, d)
+        d = jnp.clip(d, float(-2 ** 63), float(2 ** 63 - 1))
+        return d.astype(jnp.int64)
+
+
+class Pow(BinaryExpression):
+    def result_dtype(self, lt, rt):
+        return T.FLOAT64
+
+    def do_cpu(self, l, r):
+        return np.power(l.astype(np.float64), r.astype(np.float64))
+
+    def do_tpu(self, l, r):
+        jnp = _jnp()
+        return jnp.power(l.astype(jnp.float64), r.astype(jnp.float64))
+
+
+class Atan2(BinaryExpression):
+    def result_dtype(self, lt, rt):
+        return T.FLOAT64
+
+    def do_cpu(self, l, r):
+        return np.arctan2(l.astype(np.float64), r.astype(np.float64))
+
+    def do_tpu(self, l, r):
+        jnp = _jnp()
+        return jnp.arctan2(l.astype(jnp.float64), r.astype(jnp.float64))
+
+
+class ToDegrees(_DoubleUnary):
+    np_fn = staticmethod(np.degrees)
+    jnp_name = "degrees"
+
+
+class ToRadians(_DoubleUnary):
+    np_fn = staticmethod(np.radians)
+    jnp_name = "radians"

@@ -1,0 +1,185 @@
+"""Nondeterministic / context expressions.
+
+Capability parity with the reference's GpuRandomExpressions.scala,
+GpuSparkPartitionID.scala, GpuMonotonicallyIncreasingID.scala,
+GpuInputFileBlock.scala.  These read the per-task execution context
+(partition id, input file, running row offset) from a thread-local set by
+the task runner — the analogue of Spark's TaskContext.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import DeviceColumn, HostColumn
+from .expression import Expression
+
+
+class TaskContext(threading.local):
+    """Per-task execution context (reference: Spark TaskContext +
+    InputFileBlockHolder)."""
+
+    def __init__(self):
+        self.partition_id = 0
+        self.input_file = ""
+        self.input_file_block_start = 0
+        self.input_file_block_length = 0
+        self.row_offset = 0  # running row count for monotonically_increasing_id
+        self.rng_seed = 0
+
+
+context = TaskContext()
+
+
+class Rand(Expression):
+    """rand(seed) — per-row uniform [0,1).  Nondeterministic: disables
+    coalescing above it (same as the reference, which marks Rand
+    nondeterministic and disables coalesce until input)."""
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self.seed = seed
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    @property
+    def deterministic(self):
+        return False
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_cpu(self, batch):
+        rng = np.random.default_rng(
+            (self.seed + context.partition_id) * 0x9E3779B9
+            + context.row_offset)
+        return HostColumn(T.FLOAT64,
+                          rng.random(batch.num_rows, dtype=np.float64), None)
+
+    def eval_tpu(self, batch):
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.key(
+            (self.seed + context.partition_id) * 0x9E3779B9
+            + context.row_offset)
+        data = jax.random.uniform(key, (batch.padded_rows,),
+                                  dtype=jnp.float64)
+        return DeviceColumn(T.FLOAT64, data,
+                            jnp.ones((batch.padded_rows,), dtype=jnp.bool_))
+
+
+class SparkPartitionID(Expression):
+    @property
+    def dtype(self):
+        return T.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def deterministic(self):
+        return False
+
+    def eval_cpu(self, batch):
+        return HostColumn(
+            T.INT32,
+            np.full(batch.num_rows, context.partition_id, dtype=np.int32),
+            None)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        return DeviceColumn(
+            T.INT32, jnp.full((n,), context.partition_id, dtype=jnp.int32),
+            jnp.ones((n,), dtype=jnp.bool_))
+
+
+class MonotonicallyIncreasingID(Expression):
+    """(partition_id << 33) | row_index — Spark's layout."""
+
+    @property
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def deterministic(self):
+        return False
+
+    def eval_cpu(self, batch):
+        base = (np.int64(context.partition_id) << np.int64(33)) \
+            + np.int64(context.row_offset)
+        data = base + np.arange(batch.num_rows, dtype=np.int64)
+        return HostColumn(T.INT64, data, None)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        base = (context.partition_id << 33) + context.row_offset
+        data = base + jnp.arange(n, dtype=jnp.int64)
+        return DeviceColumn(T.INT64, data,
+                            jnp.ones((n,), dtype=jnp.bool_))
+
+
+class InputFileName(Expression):
+    @property
+    def dtype(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def has_input_file_intrinsic(self):
+        return True
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        out = np.empty(n, dtype=object)
+        out[:] = context.input_file
+        return HostColumn(T.STRING, out, None)
+
+
+class InputFileBlockStart(Expression):
+    @property
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def has_input_file_intrinsic(self):
+        return True
+
+    def eval_cpu(self, batch):
+        return HostColumn(
+            T.INT64,
+            np.full(batch.num_rows, context.input_file_block_start,
+                    dtype=np.int64), None)
+
+
+class InputFileBlockLength(Expression):
+    @property
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def has_input_file_intrinsic(self):
+        return True
+
+    def eval_cpu(self, batch):
+        return HostColumn(
+            T.INT64,
+            np.full(batch.num_rows, context.input_file_block_length,
+                    dtype=np.int64), None)

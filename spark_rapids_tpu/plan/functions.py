@@ -1,0 +1,431 @@
+"""User-facing column expression API (``spark_rapids_tpu.f``).
+
+The dataframe-level functions surface, mirroring the expression inventory
+the reference accelerates (GpuOverrides.scala:454-1449 expression rules).
+``Column`` wraps an ``ops.expression.Expression`` and overloads operators.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+from .. import types as T
+from ..ops import aggregates as agg
+from ..ops import arithmetic as ar
+from ..ops import bitwise as bw
+from ..ops import conditional as cond
+from ..ops import datetimeexprs as dt
+from ..ops import mathexprs as m
+from ..ops import miscexprs as misc
+from ..ops import nullexprs as ne
+from ..ops import predicates as pred
+from ..ops import stringexprs as s
+from ..ops.cast import Cast
+from ..ops.expression import (
+    Alias,
+    Expression,
+    Literal,
+    UnresolvedAttribute,
+)
+
+
+class Column:
+    """Wrapper over an Expression with pythonic operators."""
+
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # arithmetic
+    def __add__(self, other):
+        return Column(ar.Add(self.expr, _e(other)))
+
+    def __radd__(self, other):
+        return Column(ar.Add(_e(other), self.expr))
+
+    def __sub__(self, other):
+        return Column(ar.Subtract(self.expr, _e(other)))
+
+    def __rsub__(self, other):
+        return Column(ar.Subtract(_e(other), self.expr))
+
+    def __mul__(self, other):
+        return Column(ar.Multiply(self.expr, _e(other)))
+
+    def __rmul__(self, other):
+        return Column(ar.Multiply(_e(other), self.expr))
+
+    def __truediv__(self, other):
+        return Column(ar.Divide(self.expr, _e(other)))
+
+    def __rtruediv__(self, other):
+        return Column(ar.Divide(_e(other), self.expr))
+
+    def __mod__(self, other):
+        return Column(ar.Remainder(self.expr, _e(other)))
+
+    def __neg__(self):
+        return Column(ar.UnaryMinus(self.expr))
+
+    # comparisons
+    def __eq__(self, other):  # type: ignore[override]
+        return Column(pred.EqualTo(self.expr, _e(other)))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return Column(pred.Not(pred.EqualTo(self.expr, _e(other))))
+
+    def __lt__(self, other):
+        return Column(pred.LessThan(self.expr, _e(other)))
+
+    def __le__(self, other):
+        return Column(pred.LessThanOrEqual(self.expr, _e(other)))
+
+    def __gt__(self, other):
+        return Column(pred.GreaterThan(self.expr, _e(other)))
+
+    def __ge__(self, other):
+        return Column(pred.GreaterThanOrEqual(self.expr, _e(other)))
+
+    # boolean
+    def __and__(self, other):
+        return Column(pred.And(self.expr, _e(other)))
+
+    def __or__(self, other):
+        return Column(pred.Or(self.expr, _e(other)))
+
+    def __invert__(self):
+        return Column(pred.Not(self.expr))
+
+    # misc
+    def alias(self, name: str) -> "Column":
+        return Column(Alias(self.expr, name))
+
+    def cast(self, to: Union[str, T.DType]) -> "Column":
+        to_t = T.from_name(to) if isinstance(to, str) else to
+        return Column(Cast(self.expr, to_t))
+
+    def is_null(self) -> "Column":
+        return Column(pred.IsNull(self.expr))
+
+    def is_not_null(self) -> "Column":
+        return Column(pred.IsNotNull(self.expr))
+
+    def isin(self, *values) -> "Column":
+        vals = list(values[0]) if len(values) == 1 and isinstance(
+            values[0], (list, tuple, set)) else list(values)
+        return Column(pred.InSet(self.expr, vals))
+
+    def eq_null_safe(self, other) -> "Column":
+        return Column(pred.EqualNullSafe(self.expr, _e(other)))
+
+    def asc(self) -> "SortKey":
+        return SortKey(self.expr, ascending=True)
+
+    def desc(self) -> "SortKey":
+        return SortKey(self.expr, ascending=False)
+
+    def substr(self, pos: int, length: Optional[int] = None) -> "Column":
+        return Column(s.Substring(self.expr, pos, length))
+
+    def startswith(self, prefix: str) -> "Column":
+        return Column(s.StartsWith(self.expr, prefix))
+
+    def endswith(self, suffix: str) -> "Column":
+        return Column(s.EndsWith(self.expr, suffix))
+
+    def contains(self, needle: str) -> "Column":
+        return Column(s.Contains(self.expr, needle))
+
+    def like(self, pattern: str) -> "Column":
+        return Column(s.Like(self.expr, pattern))
+
+    def rlike(self, pattern: str) -> "Column":
+        import re as _re
+
+        class _RLike(s.Like):
+            def __init__(self, child, pat):
+                Expression.__init__(self, [child])
+                self.pattern = pat
+                self.escape = "\\"
+                self._re = _re.compile(pat)
+
+        return Column(_RLike(self.expr, pattern))
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):  # pragma: no cover
+        return f"Column({self.expr.sql()})"
+
+
+class SortKey:
+    def __init__(self, expr: Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.expr = expr
+        self.ascending = ascending
+        # Spark default: nulls first for ASC, nulls last for DESC
+        self.nulls_first = ascending if nulls_first is None else nulls_first
+
+    def nulls_first_(self):
+        return SortKey(self.expr, self.ascending, True)
+
+    def nulls_last_(self):
+        return SortKey(self.expr, self.ascending, False)
+
+
+def _e(x) -> Expression:
+    if isinstance(x, Column):
+        return x.expr
+    if isinstance(x, Expression):
+        return x
+    return Literal(x)
+
+
+def _c(x) -> Column:
+    return x if isinstance(x, Column) else (
+        Column(x) if isinstance(x, Expression) else Column(Literal(x)))
+
+
+# --- constructors ---------------------------------------------------------
+def col(name: str) -> Column:
+    return Column(UnresolvedAttribute(name))
+
+
+def lit(v: Any, dtype=None) -> Column:
+    return Column(Literal(v, dtype))
+
+
+# --- aggregates -----------------------------------------------------------
+class AggColumn(Column):
+    def __init__(self, func: agg.AggregateFunction,
+                 name: Optional[str] = None):
+        super().__init__(agg.AggregateExpression(func))
+        self.func = func
+        self._name = name
+
+    def alias(self, name: str) -> "AggColumn":
+        out = AggColumn(self.func, name)
+        return out
+
+
+def sum(c) -> AggColumn:  # noqa: A001 - mirrors pyspark naming
+    return AggColumn(agg.Sum(_e(c)))
+
+
+def count(c="*") -> AggColumn:
+    child = None if (isinstance(c, str) and c == "*") else _e(c)
+    return AggColumn(agg.Count(child))
+
+
+def avg(c) -> AggColumn:
+    return AggColumn(agg.Average(_e(c)))
+
+
+mean = avg
+
+
+def min(c) -> AggColumn:  # noqa: A001
+    return AggColumn(agg.Min(_e(c)))
+
+
+def max(c) -> AggColumn:  # noqa: A001
+    return AggColumn(agg.Max(_e(c)))
+
+
+def first(c, ignore_nulls: bool = False) -> AggColumn:
+    return AggColumn(agg.First(_e(c), ignore_nulls))
+
+
+def last(c, ignore_nulls: bool = False) -> AggColumn:
+    return AggColumn(agg.Last(_e(c), ignore_nulls))
+
+
+# --- conditionals ---------------------------------------------------------
+class WhenBuilder:
+    def __init__(self, branches):
+        self._branches = branches
+
+    def when(self, condition, value) -> "WhenBuilder":
+        return WhenBuilder(self._branches + [(_e(condition), _e(value))])
+
+    def otherwise(self, value) -> Column:
+        return Column(cond.CaseWhen(self._branches, _e(value)))
+
+    def end(self) -> Column:
+        return Column(cond.CaseWhen(self._branches, None))
+
+
+def when(condition, value) -> WhenBuilder:
+    return WhenBuilder([(_e(condition), _e(value))])
+
+
+def if_(c, t, f) -> Column:
+    return Column(cond.If(_e(c), _e(t), _e(f)))
+
+
+def coalesce(*cols) -> Column:
+    return Column(ne.Coalesce([_e(c) for c in cols]))
+
+
+def nanvl(a, b) -> Column:
+    return Column(ne.NaNvl(_e(a), _e(b)))
+
+
+def isnan(c) -> Column:
+    return Column(pred.IsNaN(_e(c)))
+
+
+# --- math -----------------------------------------------------------------
+def _u(cls):
+    def fn(c):
+        return Column(cls(_e(c)))
+
+    return fn
+
+
+abs = _u(ar.Abs)  # noqa: A001
+sqrt = _u(m.Sqrt)
+cbrt = _u(m.Cbrt)
+exp = _u(m.Exp)
+log = _u(m.Log)
+log2 = _u(m.Log2)
+log10 = _u(m.Log10)
+sin = _u(m.Sin)
+cos = _u(m.Cos)
+tan = _u(m.Tan)
+asin = _u(m.Asin)
+acos = _u(m.Acos)
+atan = _u(m.Atan)
+sinh = _u(m.Sinh)
+cosh = _u(m.Cosh)
+tanh = _u(m.Tanh)
+floor = _u(m.Floor)
+ceil = _u(m.Ceil)
+signum = _u(m.Signum)
+rint = _u(m.Rint)
+degrees = _u(m.ToDegrees)
+radians = _u(m.ToRadians)
+
+
+def pow(l, r) -> Column:  # noqa: A001
+    return Column(m.Pow(_e(l), _e(r)))
+
+
+def atan2(l, r) -> Column:
+    return Column(m.Atan2(_e(l), _e(r)))
+
+
+def pmod(l, r) -> Column:
+    return Column(ar.Pmod(_e(l), _e(r)))
+
+
+def shiftleft(c, n) -> Column:
+    return Column(bw.ShiftLeft(_e(c), _e(n)))
+
+
+def shiftright(c, n) -> Column:
+    return Column(bw.ShiftRight(_e(c), _e(n)))
+
+
+def shiftrightunsigned(c, n) -> Column:
+    return Column(bw.ShiftRightUnsigned(_e(c), _e(n)))
+
+
+def bitwise_not(c) -> Column:
+    return Column(bw.BitwiseNot(_e(c)))
+
+
+def greatest(*cols) -> Column:
+    e = _e(cols[0])
+    for c in cols[1:]:
+        e = ar.Greatest(e, _e(c))
+    return Column(e)
+
+
+def least(*cols) -> Column:
+    e = _e(cols[0])
+    for c in cols[1:]:
+        e = ar.Least(e, _e(c))
+    return Column(e)
+
+
+# --- strings --------------------------------------------------------------
+upper = _u(s.Upper)
+lower = _u(s.Lower)
+initcap = _u(s.InitCap)
+length = _u(s.Length)
+trim = _u(s.StringTrim)
+ltrim = _u(s.StringTrimLeft)
+rtrim = _u(s.StringTrimRight)
+
+
+def substring(c, pos: int, length_: int) -> Column:
+    return Column(s.Substring(_e(c), pos, length_))
+
+
+def substring_index(c, delim: str, count_: int) -> Column:
+    return Column(s.SubstringIndex(_e(c), delim, count_))
+
+
+def concat(*cols) -> Column:
+    return Column(s.ConcatStrings([_e(c) for c in cols]))
+
+
+def locate(substr: str, c, pos: int = 1) -> Column:
+    return Column(s.StringLocate(substr, _e(c), pos))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    return Column(s.RegExpReplace(_e(c), pattern, replacement))
+
+
+def replace(c, search: str, replacement: str) -> Column:
+    return Column(s.StringReplace(_e(c), search, replacement))
+
+
+# --- datetime -------------------------------------------------------------
+year = _u(dt.Year)
+month = _u(dt.Month)
+dayofmonth = _u(dt.DayOfMonth)
+hour = _u(dt.Hour)
+minute = _u(dt.Minute)
+second = _u(dt.Second)
+
+
+def date_add(c, days) -> Column:
+    return Column(dt.DateAdd(_e(c), _e(days)))
+
+
+def date_sub(c, days) -> Column:
+    return Column(dt.DateSub(_e(c), _e(days)))
+
+
+def datediff(end, start) -> Column:
+    return Column(dt.DateDiff(_e(end), _e(start)))
+
+
+def to_unix_timestamp(c) -> Column:
+    return Column(dt.ToUnixTimestamp(_e(c)))
+
+
+def unix_timestamp(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    return Column(dt.UnixTimestampParse(_e(c), fmt))
+
+
+def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    return Column(dt.FromUnixTime(_e(c), fmt))
+
+
+# --- nondeterministic / context ------------------------------------------
+def rand(seed: int = 0) -> Column:
+    return Column(misc.Rand(seed))
+
+
+def spark_partition_id() -> Column:
+    return Column(misc.SparkPartitionID())
+
+
+def monotonically_increasing_id() -> Column:
+    return Column(misc.MonotonicallyIncreasingID())
+
+
+def input_file_name() -> Column:
+    return Column(misc.InputFileName())

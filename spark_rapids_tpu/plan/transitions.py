@@ -1,0 +1,97 @@
+"""Post-conversion transition optimization.
+
+Capability parity with the reference's GpuTransitionOverrides.scala:
+  * cancel adjacent transitions (DeviceToHost(HostToDevice(x)) -> x)
+  * insert TpuCoalesceBatches per each exec's children coalesce goals,
+    and merge/drop redundant coalesces (:63-146, :45-61)
+  * ``assert_is_on_tpu`` test mode: fail when an operator unexpectedly
+    stays on the host engine (:211-254) — driven by
+    spark.rapids.tpu.sql.test.enabled / test.allowedNonTpu, which the
+    pytest harness wires exactly like the reference's conftest does.
+"""
+from __future__ import annotations
+
+from ..config import TpuConf
+from ..exec.base import CoalesceGoal, RequireSingleBatch, TpuExec
+from ..exec.coalesce import TpuCoalesceBatchesExec
+from ..exec.transitions import DeviceToHostExec, HostToDeviceExec
+from . import physical as P
+
+
+class TpuTransitionOverrides:
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+
+    def apply(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
+        plan = self._optimize_transitions(plan)
+        plan = self._insert_coalesce(plan, goal=None)
+        plan = self._optimize_coalesce(plan)
+        if isinstance(plan, TpuExec):
+            # final host boundary (reference: GpuBringBackToHost)
+            plan = DeviceToHostExec(plan)
+        if self.conf.is_test_enabled:
+            self._assert_is_on_tpu(plan)
+        return plan
+
+    # ------------------------------------------------------------------
+    def _optimize_transitions(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
+        children = [self._optimize_transitions(c) for c in plan.children]
+        if isinstance(plan, DeviceToHostExec) and \
+                isinstance(children[0], HostToDeviceExec):
+            return children[0].children[0]
+        if isinstance(plan, HostToDeviceExec) and \
+                isinstance(children[0], DeviceToHostExec):
+            return children[0].children[0]
+        if children != list(plan.children):
+            plan = plan.with_new_children(children)
+        return plan
+
+    # ------------------------------------------------------------------
+    def _insert_coalesce(self, plan: P.PhysicalPlan,
+                         goal) -> P.PhysicalPlan:
+        if isinstance(plan, TpuExec):
+            child_goals = plan.children_coalesce_goal
+        else:
+            child_goals = [None] * len(plan.children)
+        new_children = []
+        for c, g in zip(plan.children, child_goals):
+            c2 = self._insert_coalesce(c, g)
+            new_children.append(c2)
+        if new_children != list(plan.children):
+            plan = plan.with_new_children(new_children)
+        if goal is not None and isinstance(plan, TpuExec) and \
+                not isinstance(plan, TpuCoalesceBatchesExec):
+            return TpuCoalesceBatchesExec(plan, goal)
+        return plan
+
+    # ------------------------------------------------------------------
+    def _optimize_coalesce(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
+        children = [self._optimize_coalesce(c) for c in plan.children]
+        if isinstance(plan, TpuCoalesceBatchesExec) and \
+                isinstance(children[0], TpuCoalesceBatchesExec):
+            # merge adjacent: keep the stronger goal
+            inner = children[0]
+            merged_goal = plan.goal.max_with(inner.goal)
+            return TpuCoalesceBatchesExec(inner.children[0], merged_goal)
+        if children != list(plan.children):
+            plan = plan.with_new_children(children)
+        return plan
+
+    # ------------------------------------------------------------------
+    def _assert_is_on_tpu(self, plan: P.PhysicalPlan) -> None:
+        allowed = set(self.conf.allowed_non_tpu)
+        always_ok = {"LocalScanExec", "FileScanExec", "HostToDeviceExec",
+                     "DeviceToHostExec", "DataWritingCommandExec"}
+
+        def walk(p):
+            name = type(p).__name__
+            if not isinstance(p, TpuExec) and name not in always_ok \
+                    and name not in allowed:
+                raise AssertionError(
+                    f"operator {name} unexpectedly runs on the host "
+                    f"engine (test mode); allow with "
+                    f"spark.rapids.tpu.sql.test.allowedNonTpu")
+            for c in p.children:
+                walk(c)
+
+        walk(plan)

@@ -1,0 +1,135 @@
+"""Session — engine entry point and plugin bootstrap.
+
+Reference analogue: SQLPlugin / RapidsDriverPlugin / RapidsExecutorPlugin
+(Plugin.scala:145-247) + SparkSession surface.  A Session owns the conf,
+initializes the device runtime (device manager + semaphore — the
+executor-plugin init path), and drives query execution:
+
+    logical plan -> planner -> host physical plan
+      -> TpuOverrides (tag/convert)            [preColumnarTransitions]
+      -> TpuTransitionOverrides (transitions)  [postColumnarTransitions]
+      -> execute
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import types as T
+from .config import EXPORT_COLUMNAR_RDD, TpuConf
+from .data.column import HostBatch
+from .plan import logical as L
+from .plan.logical import DataFrame
+from .plan.physical import ExecContext, PhysicalPlan, collect_batches
+from .plan.planner import Planner
+
+
+class Session:
+    """User entry point.
+
+    ``Session()`` enables TPU acceleration; ``Session(tpu_enabled=False)``
+    is the pure host engine (the CPU oracle in tests)."""
+
+    _active: Optional["Session"] = None
+
+    def __init__(self, conf: Optional[Dict] = None,
+                 tpu_enabled: bool = True):
+        settings = dict(conf or {})
+        if not tpu_enabled:
+            settings.setdefault("spark.rapids.tpu.sql.enabled", False)
+        self.conf = TpuConf(settings)
+        self._executed_plans: List[PhysicalPlan] = []
+        self.capture_plans = False
+        if self.conf.is_sql_enabled:
+            from .memory.device_manager import DeviceManager
+
+            self.device_manager = DeviceManager.get_or_create(self.conf)
+        else:
+            self.device_manager = None
+        Session._active = self
+
+    # ----- data sources ----------------------------------------------------
+    def create_dataframe(self, data, schema=None,
+                         n_partitions: int = 2) -> DataFrame:
+        """From a dict of name->values, a HostBatch, or list of row tuples
+        with a Schema."""
+        if isinstance(data, HostBatch):
+            batch = data
+        elif isinstance(data, dict):
+            batch = HostBatch.from_pydict(data, schema)
+        elif isinstance(data, list):
+            assert schema is not None, "row data requires a schema"
+            cols = {f.name: [r[i] for r in data]
+                    for i, f in enumerate(schema)}
+            batch = HostBatch.from_pydict(cols, schema)
+        else:
+            raise TypeError(f"cannot create dataframe from {type(data)}")
+        return DataFrame(self, L.LocalRelation([batch], batch.schema,
+                                               n_partitions))
+
+    def read_parquet(self, *paths, schema=None, **options) -> DataFrame:
+        return self._read("parquet", list(paths), schema, options)
+
+    def read_orc(self, *paths, schema=None, **options) -> DataFrame:
+        return self._read("orc", list(paths), schema, options)
+
+    def read_csv(self, *paths, schema=None, header: bool = True,
+                 **options) -> DataFrame:
+        options = dict(options, header=header)
+        if schema is not None:
+            options["schema"] = schema
+        return self._read("csv", list(paths), schema, options)
+
+    def _read(self, fmt, paths, schema, options) -> DataFrame:
+        from .io import scans
+
+        if schema is None:
+            schema = scans.infer_schema(fmt, paths, options)
+        return DataFrame(self, L.FileScan(fmt, paths, schema, options))
+
+    # ----- execution -------------------------------------------------------
+    def physical_plan(self, plan: L.LogicalPlan) -> PhysicalPlan:
+        phys = Planner(self.conf).plan(plan)
+        if self.conf.is_sql_enabled:
+            from .plan.overrides import TpuOverrides
+            from .plan.transitions import TpuTransitionOverrides
+
+            phys = TpuOverrides(self.conf).apply(phys)
+            phys = TpuTransitionOverrides(self.conf).apply(phys)
+        return phys
+
+    def execute(self, plan: L.LogicalPlan) -> HostBatch:
+        phys = self.physical_plan(plan)
+        if self.capture_plans:
+            self._executed_plans.append(phys)
+        ctx = ExecContext(self.conf, self)
+        data = phys.execute(ctx)
+        schema = phys.schema if len(phys.schema) else plan.schema
+        return collect_batches(data, schema)
+
+    def execute_columnar(self, plan: L.LogicalPlan):
+        """Zero-copy device export: returns the list of DeviceBatches of
+        the final columnar stage (reference analogue: ColumnarRdd /
+        InternalColumnarRddConverter, requires exportColumnarRdd)."""
+        if not self.conf.get(EXPORT_COLUMNAR_RDD):
+            raise RuntimeError(
+                "set spark.rapids.tpu.sql.exportColumnarRdd=true")
+        from .ml.columnar_export import export_device_batches
+
+        return export_device_batches(self, plan)
+
+    def explain(self, plan: L.LogicalPlan, mode: str = "ALL") -> str:
+        phys = Planner(self.conf).plan(plan)
+        if not self.conf.is_sql_enabled:
+            return phys.tree_string()
+        from .plan.overrides import TpuOverrides
+
+        return TpuOverrides(self.conf.set(
+            "spark.rapids.tpu.sql.explain", mode)).explain(phys)
+
+    # ----- test hooks (reference: ExecutionPlanCaptureCallback) ------------
+    def start_capture(self):
+        self.capture_plans = True
+        self._executed_plans = []
+
+    def captured_plans(self) -> List[PhysicalPlan]:
+        return list(self._executed_plans)

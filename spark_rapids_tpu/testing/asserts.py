@@ -1,0 +1,79 @@
+"""CPU-vs-TPU equality assertions.
+
+Capability parity with the reference's asserts.py
+(assert_gpu_and_cpu_are_equal_collect, recursive typed equality with float
+ULP tolerance) and SparkQueryCompareTestSuite.runOnCpuAndGpu — the central
+test invariant: the device engine must produce results equal to the host
+oracle."""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+DEFAULT_REL_TOL = 1e-9
+
+
+def _values_equal(a, b, approx: Optional[float]) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) or math.isnan(fb):
+            return math.isnan(fa) and math.isnan(fb)
+        if math.isinf(fa) or math.isinf(fb):
+            return fa == fb
+        tol = approx if approx is not None else DEFAULT_REL_TOL
+        return math.isclose(fa, fb, rel_tol=tol, abs_tol=tol)
+    if isinstance(a, bool) or isinstance(b, bool):
+        return bool(a) == bool(b)
+    return a == b
+
+
+def _row_key(row):
+    return tuple(
+        (v is None,
+         "nan" if isinstance(v, float) and math.isnan(v) else v)
+        for v in row)
+
+
+def assert_rows_equal(cpu_rows, tpu_rows, ignore_order: bool = False,
+                      approximate_float: Optional[float] = None):
+    assert len(cpu_rows) == len(tpu_rows), (
+        f"row count mismatch: cpu={len(cpu_rows)} tpu={len(tpu_rows)}\n"
+        f"cpu={cpu_rows[:10]}\ntpu={tpu_rows[:10]}")
+    if ignore_order:
+        cpu_rows = sorted(cpu_rows, key=_row_key)
+        tpu_rows = sorted(tpu_rows, key=_row_key)
+    for i, (cr, tr) in enumerate(zip(cpu_rows, tpu_rows)):
+        assert len(cr) == len(tr), f"row {i} arity mismatch"
+        for j, (a, b) in enumerate(zip(cr, tr)):
+            assert _values_equal(a, b, approximate_float), (
+                f"row {i} col {j}: cpu={a!r} tpu={b!r}\n"
+                f"cpu row={cr}\ntpu row={tr}")
+
+
+def assert_tpu_and_cpu_are_equal_collect(
+        df_fn: Callable, data: dict,
+        ignore_order: bool = False,
+        approximate_float: Optional[float] = None,
+        conf: Optional[dict] = None,
+        n_partitions: int = 2,
+        schema=None):
+    """Run ``df_fn(df)`` against both engines on the same data and compare
+    collected results (reference: assert_gpu_and_cpu_are_equal_collect +
+    with_cpu_session/with_gpu_session)."""
+    from .. import Session
+    from ..data.column import HostBatch
+
+    if isinstance(data, dict) and schema is None:
+        data = HostBatch.from_pydict(data)
+    cpu = Session(dict(conf or {}), tpu_enabled=False)
+    tpu = Session(dict(conf or {}), tpu_enabled=True)
+    cpu_df = df_fn(cpu.create_dataframe(data, schema=schema,
+                                        n_partitions=n_partitions))
+    tpu_df = df_fn(tpu.create_dataframe(data, schema=schema,
+                                        n_partitions=n_partitions))
+    cpu_rows = cpu_df.collect()
+    tpu_rows = tpu_df.collect()
+    assert_rows_equal(cpu_rows, tpu_rows, ignore_order, approximate_float)
+    return cpu_rows

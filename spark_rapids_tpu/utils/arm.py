@@ -1,0 +1,36 @@
+"""Resource management helpers.
+
+Reference analogue: ``Arm.scala`` (withResource loan pattern) and
+``implicits.scala`` safeClose.  Python's GC covers most cases, but device
+buffers tracked by the spill framework need deterministic release, so the
+same loan-pattern API is kept."""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterable
+
+
+@contextmanager
+def with_resource(resource):
+    """``with with_resource(r) as r: ...`` — closes r on exit."""
+    try:
+        yield resource
+    finally:
+        close = getattr(resource, "close", None)
+        if close is not None:
+            close()
+
+
+def safe_close(resources: Iterable) -> None:
+    """Close all, raising the first error after attempting every close."""
+    first_err = None
+    for r in resources:
+        try:
+            close = getattr(r, "close", None)
+            if close is not None:
+                close()
+        except Exception as e:  # noqa: BLE001
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err

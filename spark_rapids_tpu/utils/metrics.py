@@ -1,0 +1,61 @@
+"""Execution metrics.
+
+Reference analogue: Spark SQLMetrics per exec (GpuExec.scala:45-60 standard
+set: numOutputRows, numOutputBatches, totalTime, peakDevMemory; per-op
+extras like sortTime/joinTime/spillSize)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class Metric:
+    __slots__ = ("name", "unit", "_value", "_lock")
+
+    def __init__(self, name: str, unit: str = "sum"):
+        self.name = name
+        self.unit = unit
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v) -> None:
+        with self._lock:
+            self._value += v
+
+    def set_max(self, v) -> None:
+        with self._lock:
+            self._value = max(self._value, v)
+
+    @property
+    def value(self):
+        return self._value
+
+    def __repr__(self):  # pragma: no cover
+        return f"{self.name}={self._value}"
+
+
+# Standard metric names (reference: GpuMetricNames)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+TOTAL_TIME = "totalTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+
+    def metric(self, name: str, unit: str = "sum") -> Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = Metric(name, unit)
+            self._metrics[name] = m
+        return m
+
+    def snapshot(self) -> Dict[str, int]:
+        return {k: m.value for k, m in self._metrics.items()}
+
+    def __getitem__(self, name: str) -> Metric:
+        return self.metric(name)

@@ -1,0 +1,55 @@
+"""Tracing & profiling ranges.
+
+Reference analogue: NVTX ranges on the hot path (NvtxRange /
+NvtxWithMetrics couple a range with a SQLMetric nanosecond accumulator, see
+SURVEY §5).  TPU equivalent: ``jax.profiler.TraceAnnotation`` so ranges show
+in xprof, with the same metric coupling so wall time lands in the engine's
+metrics too."""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+_ENABLED = False
+
+
+def enable(flag: bool = True) -> None:
+    global _ENABLED
+    _ENABLED = flag
+
+
+@contextmanager
+def trace_range(name: str, metric=None):
+    """A named profiler range; if ``metric`` is given, elapsed nanoseconds
+    are added to it (reference: NvtxWithMetrics.scala:44)."""
+    start = time.perf_counter_ns()
+    if _ENABLED:
+        import jax.profiler
+
+        with jax.profiler.TraceAnnotation(name):
+            try:
+                yield
+            finally:
+                if metric is not None:
+                    metric.add(time.perf_counter_ns() - start)
+    else:
+        try:
+            yield
+        finally:
+            if metric is not None:
+                metric.add(time.perf_counter_ns() - start)
+
+
+class DebugRange:
+    """Benchmark-facing range wrapper (reference:
+    integration_tests/.../DebugRange.scala)."""
+
+    def __init__(self, name: str):
+        self._cm = trace_range(name)
+
+    def __enter__(self):
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)

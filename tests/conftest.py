@@ -1,0 +1,55 @@
+"""Test harness configuration.
+
+Reference analogue: integration_tests conftest.py + SparkQueryCompareTest-
+Suite — dual-session equality testing with a virtual device mesh:
+tests run on CPU with 8 virtual XLA devices (multi-chip sharding testable
+without a pod, the gap the reference never filled for UCX — SURVEY §4).
+"""
+import os
+
+# Must be set before any jax *backend initialization* (jax itself is
+# already imported by the environment's sitecustomize, which registers a
+# remote-TPU PJRT plugin and forces JAX_PLATFORMS=axon; tests must run on
+# local CPU with 8 virtual devices instead).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:  # deregister the remote-TPU plugin so backends() never dials it
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # noqa: BLE001
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def cpu_session():
+    from spark_rapids_tpu import Session
+
+    return Session(tpu_enabled=False)
+
+
+@pytest.fixture()
+def tpu_session():
+    from spark_rapids_tpu import Session
+
+    return Session(tpu_enabled=True)
+
+
+@pytest.fixture()
+def strict_tpu_session():
+    """TPU session in test mode: any unexpected host fallback fails the
+    test (reference: spark.rapids.sql.test.enabled wiring in conftest)."""
+    from spark_rapids_tpu import Session
+
+    return Session({"spark.rapids.tpu.sql.test.enabled": True})

@@ -1,0 +1,190 @@
+"""Projection/filter expression equality tests — CPU oracle vs TPU engine.
+
+Reference analogues: ProjectExprSuite, arithmetic_ops_test.py,
+cmp_test.py, conditionals_test.py.
+"""
+import pytest
+
+from spark_rapids_tpu import f
+from spark_rapids_tpu.testing import datagen as dg
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+)
+
+
+def _num_data(n=200, seed=0):
+    return dg.gen_batch({
+        "a": dg.IntGen(dg.T.INT32),
+        "b": dg.IntGen(dg.T.INT64),
+        "c": dg.FloatGen(dg.T.FLOAT64),
+        "d": dg.IntGen(dg.T.INT32, min_val=-100, max_val=100),
+        "e": dg.FloatGen(dg.T.FLOAT32),
+    }, n, seed)
+
+
+@pytest.mark.parametrize("expr_fn", [
+    lambda df: df["a"] + df["d"],
+    lambda df: df["a"] - df["d"],
+    lambda df: df["a"] * df["d"],
+    lambda df: df["c"] / df["d"],
+    lambda df: df["a"] % df["d"],
+    lambda df: -df["a"],
+    lambda df: f.abs(df["d"]),
+    lambda df: f.pmod(df["a"], df["d"]),
+], ids=["add", "sub", "mul", "div", "mod", "neg", "abs", "pmod"])
+def test_arithmetic(expr_fn):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(expr_fn(df).alias("out")), _num_data())
+
+
+@pytest.mark.parametrize("expr_fn", [
+    lambda df: df["a"] == df["d"],
+    lambda df: df["a"] < df["d"],
+    lambda df: df["c"] >= df["e"],
+    lambda df: (df["a"] > 0) & (df["d"] < 0),
+    lambda df: (df["a"] > 0) | (df["d"] < 0),
+    lambda df: ~(df["a"] > 0),
+    lambda df: df["a"].is_null(),
+    lambda df: df["c"].is_not_null(),
+    lambda df: f.isnan(df["c"]),
+    lambda df: df["d"].isin(1, 2, 3, None),
+    lambda df: df["a"].eq_null_safe(df["d"]),
+], ids=["eq", "lt", "ge", "and", "or", "not", "isnull", "isnotnull",
+        "isnan", "isin", "eqns"])
+def test_predicates(expr_fn):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(expr_fn(df).alias("out")), _num_data())
+
+
+def test_filter():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.filter((df["a"] > 0) & df["c"].is_not_null())
+        .select("a", "c"),
+        _num_data(500))
+
+
+def test_conditional():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            f.when(df["a"] > 0, df["b"]).when(df["a"] < -100, 0)
+            .otherwise(-df["b"]).alias("cw"),
+            f.if_(df["d"] > 0, df["a"], df["d"]).alias("iff"),
+            f.coalesce(df["a"], df["d"], f.lit(7)).alias("co"),
+            f.nanvl(df["c"], df["e"]).alias("nv"),
+        ), _num_data())
+
+
+def test_casts():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            df["a"].cast("bigint").alias("i64"),
+            df["b"].cast("int").alias("i32"),
+            df["c"].cast("int").alias("f2i"),
+            df["a"].cast("double").alias("i2d"),
+            df["a"].cast("boolean").alias("i2b"),
+            df["c"].cast("float").alias("d2f"),
+        ), _num_data())
+
+
+def test_math():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            f.sqrt(f.abs(df["c"])).alias("sqrt"),
+            f.floor(df["c"]).alias("floor"),
+            f.ceil(df["c"]).alias("ceil"),
+            f.exp(df["d"] % 10).alias("exp"),
+            f.log(f.abs(df["a"]) + 1).alias("log"),
+            f.pow(df["d"], f.lit(2.0)).alias("pow"),
+            f.rint(df["c"]).alias("rint"),
+        ), _num_data(), approximate_float=1e-12)
+
+
+def test_bitwise():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            (f.shiftleft(df["a"], f.lit(3))).alias("shl"),
+            (f.shiftright(df["b"], f.lit(7))).alias("shr"),
+            f.shiftrightunsigned(df["a"], f.lit(2)).alias("sru"),
+            f.bitwise_not(df["a"]).alias("bnot"),
+        ), _num_data())
+
+
+def test_strings_device():
+    data = dg.gen_batch({
+        "s": dg.StringGen(max_len=15),
+        "t": dg.StringGen(max_len=6),
+    }, 300, 7)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            f.length(df["s"]).alias("len"),
+            df["s"].contains("a").alias("has_a"),
+            df["s"].startswith("A").alias("sw"),
+            df["s"].endswith("z").alias("ew"),
+            f.concat(df["s"], f.lit("-"), df["t"]).alias("cat"),
+            f.substring(df["s"], 2, 3).alias("sub"),
+            f.locate("b", df["s"]).alias("loc"),
+            f.trim(f.concat(f.lit("  "), df["s"], f.lit(" "))).alias("tr"),
+            (df["s"] < df["t"]).alias("cmp"),
+            (df["s"] == df["t"]).alias("eq"),
+        ), data)
+
+
+def test_string_case_incompat_gate():
+    data = {"s": ["MixedCase", "lower", "UPPER", None]}
+    # default: Upper/Lower tagged off (incompat) -> runs on host, equal
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(f.upper(df["s"]).alias("u"),
+                             f.lower(df["s"]).alias("l")), data)
+    # enabled: device ASCII path, still equal for ASCII data
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(f.upper(df["s"]).alias("u")), data,
+        conf={"spark.rapids.tpu.sql.incompatibleOps.enabled": True})
+
+
+def test_datetime():
+    data = dg.gen_batch({
+        "dt": dg.DateGen(),
+        "ts": dg.TimestampGen(),
+        "n": dg.IntGen(dg.T.INT32, min_val=-1000, max_val=1000),
+    }, 300, 11)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            f.year(df["dt"]).alias("y"),
+            f.month(df["dt"]).alias("m"),
+            f.dayofmonth(df["dt"]).alias("d"),
+            f.year(df["ts"]).alias("ty"),
+            f.hour(df["ts"]).alias("th"),
+            f.minute(df["ts"]).alias("tm"),
+            f.second(df["ts"]).alias("tsec"),
+            f.date_add(df["dt"], df["n"]).alias("da"),
+            f.datediff(df["dt"], f.lit(0, dg.T.DATE32)).alias("dd"),
+            df["ts"].cast("date").alias("t2d"),
+            df["dt"].cast("timestamp").alias("d2t"),
+        ), data)
+
+
+def test_union_limit():
+    data = _num_data(100)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select("a", "b").union(df.select("d", "b")),
+        data, ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select("a").limit(17), data, ignore_order=True)
+
+
+def test_explain_and_fallback_report():
+    from spark_rapids_tpu import Session
+
+    sess = Session()
+    df = sess.create_dataframe(_num_data(50))
+    out = df.filter(df["a"] > 0).select((df["a"] + 1).alias("x"))
+    report = out.explain()
+    assert "*" in report  # something runs on TPU
+    assert "TpuProject" not in report  # explain is the tagged host plan
+
+
+def test_strict_mode_catches_fallback(strict_tpu_session):
+    # rlike has no device impl -> strict mode must raise
+    df = strict_tpu_session.create_dataframe({"s": ["a", "b"]})
+    with pytest.raises(AssertionError):
+        df.select(df["s"].rlike("a.*").alias("m")).collect()
