@@ -20,8 +20,16 @@ from __future__ import annotations
 
 import os
 
-# int64/float64 columns require x64 mode; must be set before jax runs.
+# int64/float64 columns require x64 mode. The env var only works if jax
+# is not yet initialized; the config update covers the (common) case where
+# the environment preimports jax before this package loads.
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+try:
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+except Exception:  # noqa: BLE001 - jax optional at import time
+    pass
 
 __version__ = "0.1.0"
 

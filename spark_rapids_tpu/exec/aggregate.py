@@ -1,0 +1,304 @@
+"""Device hash aggregate.
+
+Reference analogue: GpuHashAggregateExec (aggregate.scala:227-396) — the
+mode-aware (partial/final/complete) columnar aggregate.  The reference
+lowers to cudf's hash groupBy; hash tables scatter randomly, which is
+hostile to the TPU memory model, so this exec is sort-based: lexsort rows
+by key, derive segment ids at key-change boundaries, then segment
+reductions with a *static* segment count (the row bucket) so shapes stay
+XLA-friendly (SURVEY §7 Hard parts: sort + segment-reduce).
+
+The whole aggregate — key eval, sort, segment ids, every buffer reduction,
+and the finalize expressions — traces into ONE jitted XLA program per
+(schema, row-bucket), so XLA fuses the elementwise work into the sort and
+reduction loops.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .. import types as T
+from ..data.column import DeviceBatch, DeviceColumn
+from ..ops.aggregates import AggregateFunction
+from ..ops.expression import BoundReference, as_device_column
+from ..ops.kernels import gather as G
+from ..ops.kernels import segment as seg
+from ..utils import metrics as M
+from ..utils.tracing import trace_range
+from .base import DevicePartitionedData, RequireSingleBatch, TpuExec
+
+
+def _string_minmax_device(col: DeviceColumn, valid, seg_ids,
+                          n_segments: int, op: str):
+    """min/max over a string column per segment via rank encoding:
+    lexsort the values once, invert to per-row ranks, reduce ranks per
+    segment, then gather the winning rows."""
+    import jax.numpy as jnp
+
+    n = col.data.shape[0]
+    order = seg.lexsort_device([col], pad_valid=valid)
+    rank = jnp.zeros((n,), dtype=jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    big = n + 1
+    key = jnp.where(valid, rank, big if op == "min" else -1)
+    import jax
+
+    fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+    picked_rank = fn(key, seg_ids, num_segments=n_segments)
+    safe = jnp.clip(picked_rank, 0, n - 1).astype(jnp.int32)
+    picked_row = order[safe]
+    data = col.data[picked_row]
+    lengths = col.lengths[picked_row]
+    return data, lengths
+
+
+class TpuHashAggregateExec(TpuExec):
+    """Sort-based group-by on device; wraps the host plan node to reuse its
+    bound keys/specs/schema (modes are identical)."""
+
+    def __init__(self, child, plan):
+        super().__init__([child])
+        self.plan = plan  # physical.HashAggregateExec (exprs already bound)
+        self.mode = plan.mode
+        self.keys = plan.keys
+        self.specs = plan.specs
+        self._schema = plan.schema
+        import jax
+
+        self._kernel = jax.jit(self._compute)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def children_coalesce_goal(self):
+        # one sort amortizes over all rows in the partition (reference
+        # instead loops concat+merge per batch; single-batch is the
+        # TPU-friendly equivalent until size goals demand chunking)
+        return [RequireSingleBatch()]
+
+    # ------------------------------------------------------------------
+    def _compute(self, batch: DeviceBatch) -> DeviceBatch:
+        import jax
+        import jax.numpy as jnp
+
+        nkeys = len(self.keys)
+        padded = batch.padded_rows
+        rm = batch.row_mask()
+
+        # ----- keys ----------------------------------------------------
+        if self.mode == "final":
+            key_cols = [batch.columns[i] for i in range(nkeys)]
+        else:
+            key_cols = [as_device_column(k.eval_tpu(batch), padded)
+                        for k in self.keys]
+        key_cols = [DeviceColumn(c.dtype, c.data, c.validity & rm,
+                                 c.lengths) for c in key_cols]
+
+        # ----- sort + segments -----------------------------------------
+        if nkeys:
+            order = seg.lexsort_device(key_cols, pad_valid=rm)
+            sorted_keys = [G.gather_column(c, order) for c in key_cols]
+            pad_sorted = rm[order]
+            seg_ids = seg.segment_ids_device(sorted_keys,
+                                             pad_valid=pad_sorted)
+            total = rm.sum().astype(jnp.int32)
+            n_real = jnp.where(
+                total > 0,
+                seg_ids[jnp.clip(total - 1, 0, padded - 1)] + 1, 0)
+        else:
+            order = jnp.arange(padded, dtype=jnp.int32)
+            pad_sorted = rm
+            seg_ids = jnp.where(rm, 0,  # padding rows -> own segments
+                                jnp.arange(padded, dtype=jnp.int32) + 1
+                                ).astype(jnp.int32)
+            sorted_keys = []
+            n_real = jnp.asarray(1, dtype=jnp.int32)
+
+        out_valid_seg = jnp.arange(padded, dtype=jnp.int32) < n_real
+
+        # output key columns = first row of each segment
+        idx = jnp.arange(padded, dtype=jnp.int64)
+        seg_starts = jax.ops.segment_min(idx, seg_ids, num_segments=padded)
+        safe_starts = jnp.clip(seg_starts, 0, padded - 1).astype(jnp.int32)
+        out_keys = []
+        for c in sorted_keys:
+            g = G.gather_column(c, safe_starts, out_valid_seg)
+            out_keys.append(g)
+
+        # ----- reductions ----------------------------------------------
+        if self.mode in ("partial", "complete"):
+            buffers = self._update_buffers(
+                batch, order, pad_sorted, seg_ids, padded, out_valid_seg)
+        else:
+            buffers = self._merge_buffers(
+                batch, order, pad_sorted, seg_ids, padded, out_valid_seg,
+                nkeys)
+
+        if self.mode == "partial":
+            out_cols = out_keys + buffers
+            return DeviceBatch(self._schema, out_cols, n_real)
+        return self._finalize(out_keys, buffers, n_real, padded,
+                              out_valid_seg)
+
+    # ------------------------------------------------------------------
+    def _update_buffers(self, batch, order, pad_sorted, seg_ids, padded,
+                        out_valid_seg) -> List[DeviceColumn]:
+        import jax.numpy as jnp
+
+        buffers = []
+        for sp in self.specs:
+            func: AggregateFunction = sp.func
+            if func.child is None:  # count(*)
+                inputs = [(jnp.ones((padded,), dtype=jnp.int64),
+                           pad_sorted, None)]
+            else:
+                c = as_device_column(func.child.eval_tpu(batch), padded)
+                valid = (c.validity & batch.row_mask())[order]
+                inputs = [(c.data[order], valid,
+                           c.lengths[order] if c.lengths is not None
+                           else None)]
+            for (op, which), bt in zip(func.updates, func.buffer_dtypes()):
+                vals, valid, lens = inputs[which]
+                buffers.append(self._reduce_one(
+                    vals, valid, lens, seg_ids, padded, op, bt,
+                    out_valid_seg, present=pad_sorted))
+        return buffers
+
+    def _merge_buffers(self, batch, order, pad_sorted, seg_ids, padded,
+                       out_valid_seg, nkeys) -> List[DeviceColumn]:
+        buffers = []
+        col_idx = nkeys
+        for sp in self.specs:
+            func: AggregateFunction = sp.func
+            for op, bt in zip(func.merges, func.buffer_dtypes()):
+                c = batch.columns[col_idx]
+                valid = (c.validity & batch.row_mask())[order]
+                lens = c.lengths[order] if c.lengths is not None else None
+                buffers.append(self._reduce_one(
+                    c.data[order], valid, lens, seg_ids, padded, op, bt,
+                    out_valid_seg, present=pad_sorted))
+                col_idx += 1
+        return buffers
+
+    def _reduce_one(self, vals, valid, lens, seg_ids, padded, op,
+                    buf_dtype: T.DType, out_valid_seg,
+                    present=None) -> DeviceColumn:
+        import jax.numpy as jnp
+
+        if buf_dtype.id is T.TypeId.STRING:
+            col = DeviceColumn(buf_dtype, vals, valid, lens)
+            if op in ("min", "max"):
+                data, lengths = _string_minmax_device(
+                    col, valid, seg_ids, padded, op)
+                import jax
+
+                counts = jax.ops.segment_sum(
+                    valid.astype(jnp.int32), seg_ids, num_segments=padded)
+                ok = (counts > 0) & out_valid_seg
+                return DeviceColumn(buf_dtype, data, ok, lengths)
+            # first / last pick a row index; gather bytes+lengths by it
+            if op in ("first_any", "last_any"):
+                eligible = present if present is not None \
+                    else jnp.ones_like(valid)
+            else:
+                eligible = valid
+            safe, has = seg.segment_pick_device(eligible, seg_ids,
+                                                padded, op)
+            ok = has & out_valid_seg
+            if op in ("first_any", "last_any"):
+                ok = ok & valid[safe]
+            return DeviceColumn(buf_dtype, vals[safe], ok, lens[safe])
+
+        data, ok = seg.segment_reduce_device(vals, valid, seg_ids, padded,
+                                             op, present=present)
+        if op == "count":
+            ok = out_valid_seg
+        else:
+            ok = ok & out_valid_seg
+        if data.dtype != buf_dtype.jnp_dtype:
+            data = data.astype(buf_dtype.jnp_dtype)
+        return DeviceColumn(buf_dtype, data, ok)
+
+    # ------------------------------------------------------------------
+    def _finalize(self, out_keys, buffers, n_real, padded,
+                  out_valid_seg) -> DeviceBatch:
+        from ..plan.physical import _buffer_fields
+
+        buf_schema = T.Schema(_buffer_fields(self.specs))
+        buf_batch = DeviceBatch(buf_schema, buffers, n_real)
+        out_cols = list(out_keys)
+        bi = 0
+        nkeys = len(self.keys)
+        for sp, f in zip(self.specs, self._schema.fields[nkeys:]):
+            nbuf = len(sp.func.buffer_dtypes())
+            refs = [BoundReference(bi + j, buffers[bi + j].dtype, True)
+                    for j in range(nbuf)]
+            final_expr = sp.func.finalize(refs)
+            c = as_device_column(final_expr.eval_tpu(buf_batch), padded)
+            if c.dtype != f.dtype and f.dtype.id is not T.TypeId.STRING \
+                    and c.dtype.id is not T.TypeId.STRING:
+                c = DeviceColumn(f.dtype,
+                                 c.data.astype(f.dtype.jnp_dtype),
+                                 c.validity, c.lengths)
+            c = DeviceColumn(c.dtype, c.data, c.validity & out_valid_seg,
+                             c.lengths)
+            out_cols.append(c)
+            bi += nbuf
+        return DeviceBatch(self._schema, out_cols, n_real)
+
+    # ------------------------------------------------------------------
+    def execute_columnar(self, ctx):
+        child = self.children[0].execute_columnar(ctx)
+        self._init_metrics(ctx)
+
+        def make(pid):
+            def it():
+                batches = list(child.iterator(pid))
+                if not batches:
+                    if self.keys or self.mode == "partial":
+                        return
+                    # global agg over empty input still yields one row
+                    from ..data.column import host_to_device
+                    from ..plan.physical import _empty_batch
+
+                    batches = [host_to_device(
+                        _empty_batch(self.children[0].schema))]
+                from .coalesce import concat_device_batches
+
+                batch = concat_device_batches(batches) \
+                    if len(batches) > 1 else batches[0]
+                with trace_range("TpuHashAggregate",
+                                 self.metrics[M.TOTAL_TIME]):
+                    out = self._kernel(batch)
+                self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                yield out
+
+            return it
+
+        return DevicePartitionedData(
+            [make(i) for i in range(child.n_partitions)])
+
+    def describe(self):
+        return (f"TpuHashAggregate[{self.mode}, keys={len(self.keys)}, "
+                f"aggs={[sp.func.sql() for sp in self.specs]}]")
+
+
+# ==========================================================================
+# rule registration
+# ==========================================================================
+def register(register_exec):
+    from ..plan import physical as P
+
+    def exprs_of(plan: P.HashAggregateExec):
+        out = list(plan.keys)
+        for sp in plan.specs:
+            out.append(sp.func)
+        return out
+
+    register_exec(
+        P.HashAggregateExec,
+        convert=lambda meta, ch: TpuHashAggregateExec(ch[0], meta.plan),
+        desc="sort-based segment-reduce group-by on TPU",
+        exprs_of=exprs_of)

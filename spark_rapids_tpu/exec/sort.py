@@ -1,0 +1,85 @@
+"""Device sort.
+
+Reference analogue: GpuSortExec.scala — per-partition sort via cudf
+``Table.orderBy`` with nulls-first/last handling, requiring a single batch
+per partition (coalesceGoal=RequireSingleBatch).  Here the sort is the
+device lexsort (order-preserving uint64 key passes + stable argsort —
+XLA's sort lowers onto the TPU's sorting network), followed by a gather.
+
+Global sorts get a range exchange below them from the planner, exactly as
+Spark's EnsureRequirements provides for the reference.
+"""
+from __future__ import annotations
+
+from ..ops.expression import as_device_column
+from ..ops.kernels import gather as G
+from ..ops.kernels import segment as seg
+from ..utils import metrics as M
+from ..utils.tracing import trace_range
+from .base import DevicePartitionedData, RequireSingleBatch, TpuExec
+
+
+class TpuSortExec(TpuExec):
+    def __init__(self, child, keys):
+        super().__init__([child])
+        self.keys = keys  # List[functions.SortKey], exprs already bound
+        import jax
+
+        self._kernel = jax.jit(self._compute)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    @property
+    def children_coalesce_goal(self):
+        return [RequireSingleBatch()]
+
+    def _compute(self, batch):
+        padded = batch.padded_rows
+        rm = batch.row_mask()
+        key_cols = [as_device_column(k.expr.eval_tpu(batch), padded)
+                    for k in self.keys]
+        # mask computed keys so padding rows can't influence ordering
+        key_cols = [type(c)(c.dtype, c.data, c.validity & rm, c.lengths)
+                    for c in key_cols]
+        order = seg.lexsort_device(
+            key_cols,
+            descending=[not k.ascending for k in self.keys],
+            nulls_first=[k.nulls_first for k in self.keys],
+            pad_valid=rm)
+        return G.gather_batch(batch, order, batch.num_rows)
+
+    def execute_columnar(self, ctx):
+        child = self.children[0].execute_columnar(ctx)
+        self._init_metrics(ctx)
+
+        def make(pid):
+            def it():
+                for db in child.iterator(pid):
+                    with trace_range("TpuSort",
+                                     self.metrics[M.TOTAL_TIME]):
+                        out = self._kernel(db)
+                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                    yield out
+
+            return it
+
+        return DevicePartitionedData(
+            [make(i) for i in range(child.n_partitions)])
+
+    def describe(self):
+        ks = ", ".join(
+            f"{k.expr.sql()} {'ASC' if k.ascending else 'DESC'}"
+            for k in self.keys)
+        return f"TpuSort[{ks}]"
+
+
+def register(register_exec):
+    from ..plan import physical as P
+
+    register_exec(
+        P.SortExec,
+        convert=lambda meta, ch: TpuSortExec(ch[0], meta.plan.keys),
+        desc="device lexsort (stable multi-key radix passes)",
+        exprs_of=lambda plan: [k.expr for k in plan.keys])

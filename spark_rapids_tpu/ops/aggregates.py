@@ -146,8 +146,16 @@ class Average(AggregateFunction):
 
 
 class First(AggregateFunction):
-    updates = [("first", 0)]
-    merges = ["first"]
+    """Spark semantics: ignoreNulls=false (the default) returns the first
+    ROW's value, null included; true returns the first non-null value."""
+
+    @property
+    def updates(self):
+        return [("first" if self.ignore_nulls else "first_any", 0)]
+
+    @property
+    def merges(self):
+        return ["first" if self.ignore_nulls else "first_any"]
 
     @property
     def dtype(self):
@@ -158,8 +166,13 @@ class First(AggregateFunction):
 
 
 class Last(AggregateFunction):
-    updates = [("last", 0)]
-    merges = ["last"]
+    @property
+    def updates(self):
+        return [("last" if self.ignore_nulls else "last_any", 0)]
+
+    @property
+    def merges(self):
+        return ["last" if self.ignore_nulls else "last_any"]
 
     @property
     def dtype(self):

@@ -103,16 +103,20 @@ def group_segments_np(key_cols: List[HostColumn]
         nl = is_null[order]
         if n > 1:
             neq = np.zeros(n, dtype=np.bool_)
+            # a value difference only matters when BOTH rows are valid —
+            # invalid lanes hold arbitrary data
+            both_valid = ~nl[1:] & ~nl[:-1]
             if col.dtype.is_string:
                 for i in range(1, n):
-                    neq[i] = (d[i] != d[i - 1]) or (nl[i] != nl[i - 1])
+                    neq[i] = (both_valid[i - 1] and d[i] != d[i - 1]) \
+                        or (nl[i] != nl[i - 1])
             else:
-                neq[1:] = (d[1:] != d[:-1]) | (nl[1:] != nl[:-1])
+                data_neq = (d[1:] != d[:-1]) & both_valid
                 if col.dtype.is_floating:
-                    both_nan = np.zeros(n, dtype=np.bool_)
-                    both_nan[1:] = np.isnan(d[1:].astype(np.float64)) & \
+                    both_nan = np.isnan(d[1:].astype(np.float64)) & \
                         np.isnan(d[:-1].astype(np.float64))
-                    neq[1:] &= ~both_nan[1:]
+                    data_neq &= ~both_nan
+                neq[1:] = data_neq | (nl[1:] != nl[:-1])
             change |= neq
     seg_ids = np.cumsum(change) - 1 if n else np.zeros(0, dtype=np.int64)
     seg_starts = np.nonzero(change)[0]
@@ -126,25 +130,40 @@ _NP_REDUCE = {
 }
 
 
+def segment_pick_np(eligible: np.ndarray, seg_ids: np.ndarray,
+                    n_segments: int, op: str):
+    """Pick the first/last eligible row index per segment.
+    Returns (safe_row_indices, segment_has_eligible_row)."""
+    n = len(eligible)
+    idx = np.arange(n)
+    big = n + 1
+    first = op.startswith("first")
+    key = np.where(eligible, idx, big if first else -1)
+    pick = np.full(n_segments, big if first else -1, dtype=np.int64)
+    red = np.minimum if first else np.maximum
+    red.at(pick, seg_ids, key)
+    counts = np.zeros(n_segments, dtype=np.int64)
+    np.add.at(counts, seg_ids, eligible.astype(np.int64))
+    safe = np.clip(pick, 0, max(n - 1, 0)).astype(np.int64)
+    return safe, counts > 0
+
+
 def segment_reduce_np(values: np.ndarray, valid: np.ndarray,
                       seg_ids: np.ndarray, n_segments: int, op: str):
-    """Reduce ``values`` per segment, ignoring invalid rows.
-    Returns (out_values, out_valid)."""
+    """Reduce ``values`` per segment, ignoring invalid rows (the *_any
+    picks instead consider every row — Spark's ignoreNulls=false first/
+    last).  Returns (out_values, out_valid)."""
     counts = np.zeros(n_segments, dtype=np.int64)
     np.add.at(counts, seg_ids, valid.astype(np.int64))
     if op == "count":
         return counts, np.ones(n_segments, dtype=np.bool_)
     if op in ("first", "last"):
-        idx = np.arange(len(values))
-        big = len(values) + 1
-        key = np.where(valid, idx, big if op == "first" else -1)
-        pick = np.full(n_segments, big if op == "first" else -1,
-                       dtype=np.int64)
-        red = np.minimum if op == "first" else np.maximum
-        red.at(pick, seg_ids, key)
-        ok = counts > 0
-        safe = np.clip(pick, 0, len(values) - 1)
-        return values[safe.astype(np.int64)], ok
+        safe, ok = segment_pick_np(valid, seg_ids, n_segments, op)
+        return values[safe], ok
+    if op in ("first_any", "last_any"):
+        present = np.ones(len(values), dtype=np.bool_)
+        safe, ok = segment_pick_np(present, seg_ids, n_segments, op)
+        return values[safe], ok & valid[safe]
     if op == "sum":
         if values.dtype == object:
             raise TypeError("sum of strings")
@@ -277,12 +296,16 @@ def segment_ids_device(sorted_keys: List[DeviceColumn], pad_valid=None):
     change = jnp.zeros((n,), dtype=jnp.bool_).at[0].set(True)
     for col in sorted_keys:
         v = col.validity
+        # a value difference only matters when BOTH rows are valid —
+        # computed key columns carry arbitrary data in invalid lanes
+        bv = jnp.zeros((n,), dtype=jnp.bool_).at[1:].set(v[1:] & v[:-1])
         if col.dtype.is_string:
             d = col.data
             neq = jnp.zeros((n,), dtype=jnp.bool_)
-            neq = neq.at[1:].set((d[1:] != d[:-1]).any(axis=1)
-                                 | (col.lengths[1:] != col.lengths[:-1])
-                                 | (v[1:] != v[:-1]))
+            neq = neq.at[1:].set(
+                (((d[1:] != d[:-1]).any(axis=1)
+                  | (col.lengths[1:] != col.lengths[:-1])) & bv[1:])
+                | (v[1:] != v[:-1]))
         else:
             d = col.data
             if col.dtype.is_floating:
@@ -291,11 +314,13 @@ def segment_ids_device(sorted_keys: List[DeviceColumn], pad_valid=None):
                 both_nan = both_nan.at[1:].set(jnp.isnan(d[1:])
                                                & jnp.isnan(d[:-1]))
                 neq = jnp.zeros((n,), dtype=jnp.bool_)
-                neq = neq.at[1:].set(((d[1:] != d[:-1]) & ~both_nan[1:])
-                                     | (v[1:] != v[:-1]))
+                neq = neq.at[1:].set(
+                    ((d[1:] != d[:-1]) & ~both_nan[1:] & bv[1:])
+                    | (v[1:] != v[:-1]))
             else:
                 neq = jnp.zeros((n,), dtype=jnp.bool_)
-                neq = neq.at[1:].set((d[1:] != d[:-1]) | (v[1:] != v[:-1]))
+                neq = neq.at[1:].set(((d[1:] != d[:-1]) & bv[1:])
+                                     | (v[1:] != v[:-1]))
         change = change | neq
     if pad_valid is not None:
         # every padding row becomes its own segment so it never merges
@@ -303,9 +328,30 @@ def segment_ids_device(sorted_keys: List[DeviceColumn], pad_valid=None):
     return (jnp.cumsum(change.astype(jnp.int32)) - 1).astype(jnp.int32)
 
 
-def segment_reduce_device(values, valid, seg_ids, n_segments: int, op: str):
+def segment_pick_device(eligible, seg_ids, n_segments: int, op: str):
+    """Device analogue of segment_pick_np: first/last eligible row index
+    per segment.  Returns (safe_int32_indices, segment_has_eligible)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = eligible.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    big = n + 1
+    first = op.startswith("first")
+    key = jnp.where(eligible, idx, big if first else -1)
+    fn = jax.ops.segment_min if first else jax.ops.segment_max
+    pick = fn(key, seg_ids, num_segments=n_segments)
+    counts = jax.ops.segment_sum(eligible.astype(jnp.int32), seg_ids,
+                                 num_segments=n_segments)
+    safe = jnp.clip(pick, 0, n - 1).astype(jnp.int32)
+    return safe, counts > 0
+
+
+def segment_reduce_device(values, valid, seg_ids, n_segments: int, op: str,
+                          present=None):
     """Device segment reduction; returns (out_values, out_valid) with
-    ``n_segments`` static (row bucket)."""
+    ``n_segments`` static (row bucket).  ``present`` marks real (non-
+    padding) rows for the *_any picks."""
     import jax
     import jax.numpy as jnp
 
@@ -332,12 +378,11 @@ def segment_reduce_device(values, valid, seg_ids, n_segments: int, op: str):
         acc = fn(masked, seg_ids, num_segments=n_segments)
         return acc, ok
     if op in ("first", "last"):
-        n = values.shape[0]
-        idx = jnp.arange(n, dtype=jnp.int64)
-        big = n + 1
-        key = jnp.where(valid, idx, big if op == "first" else -1)
-        fn = jax.ops.segment_min if op == "first" else jax.ops.segment_max
-        pick = fn(key, seg_ids, num_segments=n_segments)
-        safe = jnp.clip(pick, 0, n - 1).astype(jnp.int32)
-        return values[safe], ok
+        safe, has = segment_pick_device(valid, seg_ids, n_segments, op)
+        return values[safe], has
+    if op in ("first_any", "last_any"):
+        eligible = present if present is not None \
+            else jnp.ones_like(valid)
+        safe, has = segment_pick_device(eligible, seg_ids, n_segments, op)
+        return values[safe], has & valid[safe]
     raise ValueError(op)

@@ -184,6 +184,15 @@ def _c(x) -> Column:
         Column(x) if isinstance(x, Expression) else Column(Literal(x)))
 
 
+def _col_e(x) -> Expression:
+    """Resolve a column-position argument: bare strings are column NAMES
+    (pyspark convention — f.sum("v") means the column v, not the literal
+    string "v"; use f.lit("v") for the literal)."""
+    if isinstance(x, str):
+        return UnresolvedAttribute(x)
+    return _e(x)
+
+
 # --- constructors ---------------------------------------------------------
 def col(name: str) -> Column:
     return Column(UnresolvedAttribute(name))
@@ -207,35 +216,35 @@ class AggColumn(Column):
 
 
 def sum(c) -> AggColumn:  # noqa: A001 - mirrors pyspark naming
-    return AggColumn(agg.Sum(_e(c)))
+    return AggColumn(agg.Sum(_col_e(c)))
 
 
 def count(c="*") -> AggColumn:
-    child = None if (isinstance(c, str) and c == "*") else _e(c)
+    child = None if (isinstance(c, str) and c == "*") else _col_e(c)
     return AggColumn(agg.Count(child))
 
 
 def avg(c) -> AggColumn:
-    return AggColumn(agg.Average(_e(c)))
+    return AggColumn(agg.Average(_col_e(c)))
 
 
 mean = avg
 
 
 def min(c) -> AggColumn:  # noqa: A001
-    return AggColumn(agg.Min(_e(c)))
+    return AggColumn(agg.Min(_col_e(c)))
 
 
 def max(c) -> AggColumn:  # noqa: A001
-    return AggColumn(agg.Max(_e(c)))
+    return AggColumn(agg.Max(_col_e(c)))
 
 
 def first(c, ignore_nulls: bool = False) -> AggColumn:
-    return AggColumn(agg.First(_e(c), ignore_nulls))
+    return AggColumn(agg.First(_col_e(c), ignore_nulls))
 
 
 def last(c, ignore_nulls: bool = False) -> AggColumn:
-    return AggColumn(agg.Last(_e(c), ignore_nulls))
+    return AggColumn(agg.Last(_col_e(c), ignore_nulls))
 
 
 # --- conditionals ---------------------------------------------------------
@@ -270,13 +279,13 @@ def nanvl(a, b) -> Column:
 
 
 def isnan(c) -> Column:
-    return Column(pred.IsNaN(_e(c)))
+    return Column(pred.IsNaN(_col_e(c)))
 
 
 # --- math -----------------------------------------------------------------
 def _u(cls):
     def fn(c):
-        return Column(cls(_e(c)))
+        return Column(cls(_col_e(c)))
 
     return fn
 
@@ -358,11 +367,11 @@ rtrim = _u(s.StringTrimRight)
 
 
 def substring(c, pos: int, length_: int) -> Column:
-    return Column(s.Substring(_e(c), pos, length_))
+    return Column(s.Substring(_col_e(c), pos, length_))
 
 
 def substring_index(c, delim: str, count_: int) -> Column:
-    return Column(s.SubstringIndex(_e(c), delim, count_))
+    return Column(s.SubstringIndex(_col_e(c), delim, count_))
 
 
 def concat(*cols) -> Column:
@@ -370,15 +379,15 @@ def concat(*cols) -> Column:
 
 
 def locate(substr: str, c, pos: int = 1) -> Column:
-    return Column(s.StringLocate(substr, _e(c), pos))
+    return Column(s.StringLocate(substr, _col_e(c), pos))
 
 
 def regexp_replace(c, pattern: str, replacement: str) -> Column:
-    return Column(s.RegExpReplace(_e(c), pattern, replacement))
+    return Column(s.RegExpReplace(_col_e(c), pattern, replacement))
 
 
 def replace(c, search: str, replacement: str) -> Column:
-    return Column(s.StringReplace(_e(c), search, replacement))
+    return Column(s.StringReplace(_col_e(c), search, replacement))
 
 
 # --- datetime -------------------------------------------------------------
@@ -391,27 +400,27 @@ second = _u(dt.Second)
 
 
 def date_add(c, days) -> Column:
-    return Column(dt.DateAdd(_e(c), _e(days)))
+    return Column(dt.DateAdd(_col_e(c), _e(days)))
 
 
 def date_sub(c, days) -> Column:
-    return Column(dt.DateSub(_e(c), _e(days)))
+    return Column(dt.DateSub(_col_e(c), _e(days)))
 
 
 def datediff(end, start) -> Column:
-    return Column(dt.DateDiff(_e(end), _e(start)))
+    return Column(dt.DateDiff(_col_e(end), _col_e(start)))
 
 
 def to_unix_timestamp(c) -> Column:
-    return Column(dt.ToUnixTimestamp(_e(c)))
+    return Column(dt.ToUnixTimestamp(_col_e(c)))
 
 
 def unix_timestamp(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
-    return Column(dt.UnixTimestampParse(_e(c), fmt))
+    return Column(dt.UnixTimestampParse(_col_e(c), fmt))
 
 
 def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
-    return Column(dt.FromUnixTime(_e(c), fmt))
+    return Column(dt.FromUnixTime(_col_e(c), fmt))
 
 
 # --- nondeterministic / context ------------------------------------------

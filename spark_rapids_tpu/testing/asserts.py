@@ -30,10 +30,15 @@ def _values_equal(a, b, approx: Optional[float]) -> bool:
 
 
 def _row_key(row):
-    return tuple(
-        (v is None,
-         "nan" if isinstance(v, float) and math.isnan(v) else v)
-        for v in row)
+    out = []
+    for v in row:
+        if v is None:
+            out.append((2, 0))
+        elif isinstance(v, float) and math.isnan(v):
+            out.append((1, 0))
+        else:
+            out.append((0, v))
+    return tuple(out)
 
 
 def assert_rows_equal(cpu_rows, tpu_rows, ignore_order: bool = False,
