@@ -1,0 +1,66 @@
+"""Driver benchmark: flagship TPC-H Q1-shaped pipeline on the TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = TPU pipeline throughput (million rows/s, end-to-end jitted
+filter->project->group-aggregate).  vs_baseline = speedup over the host
+(CPU oracle) engine running the identical query on the same data — the
+reference publishes no numbers (BASELINE.md), so the measured CPU
+engine is the working baseline, matching the reference's CPU-Spark-vs-
+plugin framing (README.md:18-20 bit-identical promise).
+"""
+import json
+import sys
+import time
+
+
+def _host_engine_seconds(hb, iters=3):
+    from spark_rapids_tpu.models.flagship import q1_dataframe
+    from spark_rapids_tpu.session import Session
+
+    sess = Session(tpu_enabled=False)
+    df = q1_dataframe(sess, hb)
+    df.collect()  # warm any lazy init
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        df.collect()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    n_rows = 1 << 20
+    import jax
+
+    from spark_rapids_tpu.data.column import register_pytrees
+    from spark_rapids_tpu.models.flagship import (build_q1_pipeline,
+                                                  lineitem_like)
+
+    register_pytrees()
+    fn, example = build_q1_pipeline(n_rows=n_rows, seed=0)
+    jfn = jax.jit(fn)
+    out = jfn(example)  # compile + first run
+    out.block_until_ready()
+
+    iters = 10
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jfn(example).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    tpu_mrows = n_rows / best / 1e6
+
+    hb = lineitem_like(n_rows, seed=0)
+    cpu_s = _host_engine_seconds(hb)
+    cpu_mrows = n_rows / cpu_s / 1e6
+
+    print(json.dumps({
+        "metric": "tpch_q1_pipeline_throughput",
+        "value": round(tpu_mrows, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(tpu_mrows / cpu_mrows, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,9 @@ def segment_pick_np(eligible: np.ndarray, seg_ids: np.ndarray,
     """Pick the first/last eligible row index per segment.
     Returns (safe_row_indices, segment_has_eligible_row)."""
     n = len(eligible)
+    if n == 0:
+        return (np.zeros(n_segments, dtype=np.int64),
+                np.zeros(n_segments, dtype=np.bool_))
     idx = np.arange(n)
     big = n + 1
     first = op.startswith("first")
@@ -157,10 +160,15 @@ def segment_reduce_np(values: np.ndarray, valid: np.ndarray,
     np.add.at(counts, seg_ids, valid.astype(np.int64))
     if op == "count":
         return counts, np.ones(n_segments, dtype=np.bool_)
-    if op in ("first", "last"):
-        safe, ok = segment_pick_np(valid, seg_ids, n_segments, op)
-        return values[safe], ok
-    if op in ("first_any", "last_any"):
+    if op in ("first", "last", "first_any", "last_any"):
+        if len(values) == 0:
+            out = np.empty(n_segments, dtype=object) \
+                if values.dtype == object \
+                else np.zeros(n_segments, dtype=values.dtype)
+            return out, np.zeros(n_segments, dtype=np.bool_)
+        if op in ("first", "last"):
+            safe, ok = segment_pick_np(valid, seg_ids, n_segments, op)
+            return values[safe], ok
         present = np.ones(len(values), dtype=np.bool_)
         safe, ok = segment_pick_np(present, seg_ids, n_segments, op)
         return values[safe], ok & valid[safe]

@@ -1,0 +1,71 @@
+"""Distributed query step: the SPMD execution of a partitioned plan.
+
+Reference analogue: the full shuffle round-trip of §3.4 —
+GpuShuffleExchangeExec (map side) + RapidsCachingReader/
+RapidsShuffleIterator (reduce side) — expressed as ONE jitted SPMD
+program per stage pair: every device runs the map-side work on its
+local partition, the repartition happens as a compiled `all_to_all`
+over the mesh (parallel/exchange.py), and the reduce-side work runs on
+the received rows without leaving the device.  This is the SURVEY §7
+"Exchange v1 → ICI collective exchange" differentiator: the exchange is
+*inside* the XLA program, so there is no serializer, no bounce buffer,
+no transport thread — XLA schedules the ICI transfers.
+
+The canonical instance (used by __graft_entry__.dryrun_multichip and
+the distributed tests) is the two-phase aggregate:
+
+    local partial agg -> all_to_all by key hash -> final agg
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..data.column import DeviceBatch
+from . import exchange as X
+from .mesh import DATA_AXIS
+
+
+def make_two_phase_agg_step(partial_exec, final_exec, num_parts: int,
+                            axis_name: str = DATA_AXIS):
+    """Build fn(local_batch) -> local_batch running partial agg, hash
+    exchange on the group keys, and final agg — for use under
+    shard_map/jit via exchange.exchange_step.
+
+    partial_exec/final_exec: TpuHashAggregateExec instances (mode
+    'partial' and 'final') whose _compute is a pure function of a
+    DeviceBatch.
+    """
+    nkeys = len(partial_exec.keys)
+
+    def step(local: DeviceBatch) -> DeviceBatch:
+        part = partial_exec._compute(local)
+        if nkeys:
+            pids = X.device_partition_ids(part, list(range(nkeys)),
+                                          num_parts)
+        else:  # global agg: everything to partition 0
+            import jax.numpy as jnp
+
+            pids = jnp.where(part.row_mask(), 0, num_parts).astype(
+                jnp.int32)
+        received = X.collective_exchange(part, pids, num_parts, axis_name)
+        return final_exec._compute(received)
+
+    return step
+
+
+def run_two_phase_agg(mesh, partial_exec, final_exec,
+                      local_batches: List[DeviceBatch]) -> List[DeviceBatch]:
+    """Place per-partition batches on the mesh, jit + run the SPMD step,
+    return per-partition results (rows of a group land on exactly one
+    partition, like a post-shuffle final agg)."""
+    import jax
+
+    n = len(mesh.devices.flat)
+    assert len(local_batches) == n, "one batch per mesh device"
+    step = make_two_phase_agg_step(partial_exec, final_exec, n,
+                                   mesh.axis_names[0])
+    spmd = jax.jit(X.exchange_step(mesh, step))
+    stacked = X.stack_partitions(local_batches)
+    sharded = X.stack_to_mesh(mesh, stacked)
+    out = spmd(sharded)
+    return X.unstack_partitions(out)

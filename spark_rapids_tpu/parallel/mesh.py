@@ -1,0 +1,57 @@
+"""Device mesh management for distributed execution.
+
+Reference analogue: the topology side of the RAPIDS shuffle
+(RapidsShuffleInternalManager.scala:147-157 publishes `rapids=<port>`
+topology strings via MapStatus; UCX.scala owns the peer endpoints).  On
+TPU the topology is owned by XLA: we only need to pick a
+`jax.sharding.Mesh` and express the exchange as compiled collectives
+riding ICI (SURVEY §2.8 / §5 "Distributed communication backend").
+
+The parallelism model of this workload is data-parallel partitions plus
+repartitioning exchanges (SURVEY §2.8: no tensor/pipeline/sequence axes
+exist in a SQL engine) — so the canonical mesh is 1-D over the ``dp``
+axis, one shard = one partition group.  Multi-host meshes work the same
+way: `jax.devices()` spans hosts and XLA routes intra-slice traffic over
+ICI, cross-slice over DCN, replacing the reference's
+NVLink/IB-vs-TCP split (UCXShuffleTransport.scala).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+DATA_AXIS = "dp"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS):
+    """A 1-D mesh over the first ``n_devices`` devices."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                f"for CPU simulation)")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def shard_batch_arrays(mesh, *arrays, axis_name: str = DATA_AXIS):
+    """Place stacked per-partition arrays [n_parts, ...] so the leading
+    axis is split across the mesh.  n_parts must equal mesh size."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis_name))
+    return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+def replicate(mesh, *arrays):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P())
+    return tuple(jax.device_put(a, sharding) for a in arrays)

@@ -271,11 +271,11 @@ def if_(c, t, f) -> Column:
 
 
 def coalesce(*cols) -> Column:
-    return Column(ne.Coalesce([_e(c) for c in cols]))
+    return Column(ne.Coalesce([_col_e(c) for c in cols]))
 
 
 def nanvl(a, b) -> Column:
-    return Column(ne.NaNvl(_e(a), _e(b)))
+    return Column(ne.NaNvl(_col_e(a), _col_e(b)))
 
 
 def isnan(c) -> Column:
@@ -375,7 +375,7 @@ def substring_index(c, delim: str, count_: int) -> Column:
 
 
 def concat(*cols) -> Column:
-    return Column(s.ConcatStrings([_e(c) for c in cols]))
+    return Column(s.ConcatStrings([_col_e(c) for c in cols]))
 
 
 def locate(substr: str, c, pos: int = 1) -> Column:
