@@ -10,7 +10,9 @@ def register_rules(register_exec):
     for name in ("aggregate", "sort", "joins", "exchange", "window"):
         try:
             mod = importlib.import_module(f".{name}", __package__)
-        except ImportError:
+        except ModuleNotFoundError as e:
+            if e.name != f"{__package__}.{name}":
+                raise  # a real import failure inside the module
             continue
         reg = getattr(mod, "register", None)
         if reg is not None:

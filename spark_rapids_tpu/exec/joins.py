@@ -1,0 +1,244 @@
+"""Device joins: shuffled hash join + broadcast hash join.
+
+Reference analogue: GpuShuffledHashJoinExec.scala:59 (build one side
+into a single table, stream the other), GpuBroadcastHashJoinExec
+(org/apache/spark/sql/rapids/execution/...:83), shared core
+GpuHashJoin.scala:25-140, and GpuSortMergeJoinMeta (SMJ replaced by the
+shuffled join, GpuSortMergeJoinExec.scala:23).  Capability superset:
+the reference supports inner/left/semi/anti with conditions only on
+inner; this exec adds right/full outer (still condition-on-inner-only,
+matching GpuHashJoin.tagJoin's gate).
+
+The kernel is the sort-merge pipeline in ops/kernels/join.py; both
+sides require a single batch per partition (the reference's
+RequireSingleBatch on the build side, extended to both because the
+merge sorts both sides together).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import DeviceBatch, bucket_rows
+from ..ops.cast import Cast
+from ..ops.expression import Expression, as_device_column
+from ..ops.kernels import join as J
+from ..ops.kernels.gather import compact
+from ..utils import metrics as M
+from ..utils.tracing import trace_range
+from .base import DevicePartitionedData, RequireSingleBatch, TpuExec
+from .coalesce import concat_device_batches
+
+
+def _common_key_exprs(l_keys: List[Expression],
+                      r_keys: List[Expression]):
+    """Cast key pairs to a common dtype so device comparison is exact
+    (the host oracle compares python values, where 1 == 1.0)."""
+    lo, ro = [], []
+    for lk, rk in zip(l_keys, r_keys):
+        if lk.dtype.np_dtype == rk.dtype.np_dtype \
+                or lk.dtype.is_string or rk.dtype.is_string:
+            lo.append(lk)
+            ro.append(rk)
+            continue
+        common = T.from_numpy(np.promote_types(lk.dtype.np_dtype,
+                                               rk.dtype.np_dtype))
+        lo.append(lk if lk.dtype == common else Cast(lk, common))
+        ro.append(rk if rk.dtype == common else Cast(rk, common))
+    return lo, ro
+
+
+class TpuHashJoinExec(TpuExec):
+    """Shared device join core (reference: GpuHashJoin trait)."""
+
+    def __init__(self, left, right, plan):
+        super().__init__([left, right])
+        self.plan = plan  # physical.HashJoinExec (exprs already bound)
+        self.how = plan.how
+        self.left_keys, self.right_keys = _common_key_exprs(
+            plan.left_keys, plan.right_keys)
+        self.condition = plan.condition
+        self._schema = plan.schema
+        import jax
+
+        self._count_kernel = jax.jit(self._count)
+        self._expand_kernel = jax.jit(self._expand, static_argnums=0)
+        self._semi_kernel = jax.jit(self._semi_anti)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def children_coalesce_goal(self):
+        return [RequireSingleBatch(), RequireSingleBatch()]
+
+    # ------------------------------------------------------------------
+    def _keys_of(self, batch: DeviceBatch, exprs):
+        return [as_device_column(k.eval_tpu(batch), batch.padded_rows)
+                for k in exprs]
+
+    def _count(self, lb: DeviceBatch, rb: DeviceBatch):
+        pr = J.probe(self._keys_of(lb, self.left_keys),
+                     self._keys_of(rb, self.right_keys),
+                     lb.row_mask(), rb.row_mask())
+        emit, r_extra, total = J.emit_counts(pr, self.how,
+                                             lb.row_mask(), rb.row_mask())
+        return pr, emit, r_extra, total
+
+    def _expand(self, c_out: int, lb: DeviceBatch, rb: DeviceBatch,
+                pr: J.Probe, emit, r_extra) -> DeviceBatch:
+        import jax.numpy as jnp
+
+        lidx, ridx, slot_valid = J.expand_pairs(pr, emit, r_extra, c_out)
+        cols = (J.gather_side(lb.columns, lidx, slot_valid)
+                + J.gather_side(rb.columns, ridx, slot_valid))
+        num_rows = slot_valid.sum().astype(jnp.int32)
+        out = DeviceBatch(self._schema, cols, num_rows)
+        if self.condition is not None:
+            c = as_device_column(self.condition.eval_tpu(out), c_out)
+            keep = c.data.astype(jnp.bool_) & c.validity & slot_valid
+            out = compact(out, keep)
+        return out
+
+    def _semi_anti(self, lb: DeviceBatch, rb: DeviceBatch) -> DeviceBatch:
+        pr = J.probe(self._keys_of(lb, self.left_keys),
+                     self._keys_of(rb, self.right_keys),
+                     lb.row_mask(), rb.row_mask())
+        has = pr.cnt > 0
+        keep = has if self.how == "semi" else ~has
+        return compact(lb, keep)
+
+    def _join(self, lb: DeviceBatch, rb: DeviceBatch) -> DeviceBatch:
+        if self.how in ("semi", "anti"):
+            return self._semi_kernel(lb, rb)
+        pr, emit, r_extra, total = self._count_kernel(lb, rb)
+        c_out = bucket_rows(int(total))  # host sync: output sizing
+        return self._expand_kernel(c_out, lb, rb, pr, emit, r_extra)
+
+    # ------------------------------------------------------------------
+    def _one_batch(self, data, pid, side: int) -> DeviceBatch:
+        from ..data.column import host_to_device
+        from ..plan.physical import _empty_batch
+
+        batches = list(data.iterator(pid))
+        if not batches:
+            return host_to_device(
+                _empty_batch(self.children[side].schema))
+        return concat_device_batches(batches) \
+            if len(batches) > 1 else batches[0]
+
+    def execute_columnar(self, ctx):
+        raise NotImplementedError
+
+    def _metrics_wrap(self, fn):
+        with trace_range(type(self).__name__,
+                         self.metrics[M.TOTAL_TIME]):
+            out = fn()
+        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+        return out
+
+
+class TpuShuffledHashJoinExec(TpuHashJoinExec):
+    """Both sides co-partitioned by the exchange; joins partition-wise
+    (reference: GpuShuffledHashJoinExec.doExecuteColumnar:88)."""
+
+    def execute_columnar(self, ctx):
+        left = self.children[0].execute_columnar(ctx)
+        right = self.children[1].execute_columnar(ctx)
+        self._init_metrics(ctx)
+        assert left.n_partitions == right.n_partitions, \
+            "shuffled join requires co-partitioned children"
+
+        def make(pid):
+            def it():
+                lb = self._one_batch(left, pid, 0)
+                rb = self._one_batch(right, pid, 1)
+                yield self._metrics_wrap(lambda: self._join(lb, rb))
+
+            return it
+
+        return DevicePartitionedData(
+            [make(i) for i in range(left.n_partitions)])
+
+    def describe(self):
+        return f"TpuShuffledHashJoin[{self.how}]"
+
+
+class TpuBroadcastHashJoinExec(TpuHashJoinExec):
+    """Build (right) side gathered across partitions once and joined
+    against every stream partition (reference:
+    GpuBroadcastHashJoinExec.doExecuteColumnar:115 — the broadcast
+    re-upload becomes a device concat; on a mesh the build side is
+    replicated, the XLA analogue of the broadcast exchange)."""
+
+    def execute_columnar(self, ctx):
+        left = self.children[0].execute_columnar(ctx)
+        right = self.children[1].execute_columnar(ctx)
+        self._init_metrics(ctx)
+        built = []  # lazily built once, shared by all partitions
+
+        def build() -> DeviceBatch:
+            if not built:
+                batches = []
+                for pid in range(right.n_partitions):
+                    batches.extend(right.iterator(pid))
+                if batches:
+                    built.append(concat_device_batches(batches)
+                                 if len(batches) > 1 else batches[0])
+                else:
+                    from ..data.column import host_to_device
+                    from ..plan.physical import _empty_batch
+
+                    built.append(host_to_device(
+                        _empty_batch(self.children[1].schema)))
+            return built[0]
+
+        def make(pid):
+            def it():
+                lb = self._one_batch(left, pid, 0)
+                rb = build()
+                yield self._metrics_wrap(lambda: self._join(lb, rb))
+
+            return it
+
+        return DevicePartitionedData(
+            [make(i) for i in range(left.n_partitions)])
+
+    def describe(self):
+        return f"TpuBroadcastHashJoin[{self.how}]"
+
+
+# ==========================================================================
+# rule registration
+# ==========================================================================
+def register(register_exec):
+    from ..plan import physical as P
+
+    def tag(meta):
+        plan = meta.plan
+        if plan.condition is not None and plan.how != "inner":
+            # reference: GpuHashJoin.tagJoin — conditions only on inner
+            meta.will_not_work_on_tpu(
+                f"join condition on {plan.how} join is not supported "
+                f"on TPU (inner only)")
+
+    def exprs_of(plan: P.HashJoinExec):
+        out = list(plan.left_keys) + list(plan.right_keys)
+        if plan.condition is not None:
+            out.append(plan.condition)
+        return out
+
+    def convert(meta, ch):
+        cls = TpuBroadcastHashJoinExec if meta.plan.broadcast \
+            else TpuShuffledHashJoinExec
+        return cls(ch[0], ch[1], meta.plan)
+
+    register_exec(
+        P.HashJoinExec,
+        convert=convert,
+        desc="sort-merge equi-join on TPU",
+        tag=tag,
+        exprs_of=exprs_of)

@@ -1,0 +1,125 @@
+"""Device join vs CPU oracle (reference test analogue: join_test.py +
+HashAggregatesSuite-style dual-session equality)."""
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import f
+from spark_rapids_tpu import types as T
+
+
+def _norm(rows):
+    return sorted(
+        (tuple((None if v is None else
+                (round(v, 9) if isinstance(v, float) else v))
+               for v in r) for r in rows),
+        key=repr)
+
+
+def _run_both(build, how_assert_on_tpu=True):
+    tpu = srt.Session()
+    cpu = srt.Session(tpu_enabled=False)
+    tq = build(tpu)
+    cq = build(cpu)
+    if how_assert_on_tpu:
+        ex = tq.explain()
+        assert "Join" in ex and "will run on TPU" in ex, ex
+    got = _norm(tq.collect())
+    want = _norm(cq.collect())
+    assert got == want, f"\nTPU: {got}\nCPU: {want}"
+
+
+LEFT = {"k": [1, 2, 2, 3, None, 5, 6],
+        "a": [10.0, 20.0, 21.0, 30.0, 40.0, 50.0, 60.0]}
+RIGHT = {"k": [2, 2, 3, 4, None, 6],
+         "b": ["x", "y", "z", "w", "n", "q"]}
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "semi", "anti"])
+def test_join_types_match_oracle(how):
+    def build(sess):
+        l = sess.create_dataframe(LEFT)
+        r = sess.create_dataframe(RIGHT)
+        return l.join(r, on="k", how=how)
+
+    _run_both(build)
+
+
+def test_join_duplicate_heavy_keys():
+    rng = np.random.RandomState(11)
+    lk = rng.randint(0, 8, 300).tolist()
+    rk = rng.randint(0, 8, 200).tolist()
+
+    def build(sess):
+        l = sess.create_dataframe({"k": lk,
+                                   "a": list(range(300))})
+        r = sess.create_dataframe({"k": rk,
+                                   "b": list(range(200))})
+        return l.join(r, on="k", how="inner")
+
+    _run_both(build)
+
+
+def test_join_string_keys():
+    def build(sess):
+        l = sess.create_dataframe({"k": ["aa", "bb", None, "cc", "aa"],
+                                   "a": [1, 2, 3, 4, 5]})
+        r = sess.create_dataframe({"k": ["aa", "cc", "dd", None],
+                                   "b": [9.0, 8.0, 7.0, 6.0]})
+        return l.join(r, on="k", how="left")
+
+    _run_both(build)
+
+
+def test_join_mixed_dtype_keys():
+    s_int = T.Schema([T.Field("k", T.INT32), T.Field("a", T.INT64)])
+    s_dbl = T.Schema([T.Field("k", T.FLOAT64), T.Field("b", T.INT64)])
+
+    def build(sess):
+        l = sess.create_dataframe({"k": [1, 2, 3], "a": [1, 2, 3]}, s_int)
+        r = sess.create_dataframe({"k": [1.0, 3.0, 4.5],
+                                   "b": [10, 30, 45]}, s_dbl)
+        return l.join(r, on="k", how="inner")
+
+    _run_both(build)
+
+
+def test_inner_join_with_condition():
+    def build(sess):
+        l = sess.create_dataframe(LEFT)
+        r = sess.create_dataframe(RIGHT)
+        return l.join(r, on="k", how="inner",
+                      condition=f.col("a") > f.lit(15.0))
+
+    _run_both(build)
+
+
+def test_outer_join_with_condition_falls_back():
+    sess = srt.Session()
+    l = sess.create_dataframe(LEFT)
+    r = sess.create_dataframe(RIGHT)
+    # a residual condition on an outer join must fall back
+    j = l.join(r, on="k", how="left", condition=f.col("a") > f.lit(15.0))
+    ex = j.explain()
+    assert "cannot run on TPU" in ex
+    cpu = srt.Session(tpu_enabled=False)
+    lc = cpu.create_dataframe(LEFT)
+    rc = cpu.create_dataframe(RIGHT)
+    jc = lc.join(rc, on="k", how="left",
+                 condition=f.col("a") > f.lit(15.0))
+    assert _norm(j.collect()) == _norm(jc.collect())
+
+
+def test_empty_sides():
+    for lrows, rrows in [(0, 4), (4, 0), (0, 0)]:
+        def build(sess, lrows=lrows, rrows=rrows):
+            s1 = T.Schema([T.Field("k", T.INT64), T.Field("a", T.INT64)])
+            s2 = T.Schema([T.Field("k", T.INT64), T.Field("b", T.INT64)])
+            l = sess.create_dataframe(
+                {"k": list(range(lrows)), "a": list(range(lrows))}, s1)
+            r = sess.create_dataframe(
+                {"k": list(range(rrows)), "b": list(range(rrows))}, s2)
+            return l.join(r, on="k", how="left")
+
+        _run_both(build, how_assert_on_tpu=False)
