@@ -1,0 +1,139 @@
+"""Device shuffle exchange.
+
+Reference analogue: GpuShuffleExchangeExec.scala:60-244 — partition ids
+are computed on device (cudf hash-partition kernel) and batches are
+sliced on device (`Table.contiguousSplit`, Plugin.scala:54-83) so data
+never visits the host.  Here the same: partition ids come from the
+device murmur3 (bit-identical row placement to the host oracle), and
+each output partition's batch is a masked compaction of the input —
+the static-shape contiguousSplit.  Local (in-process) exchange keeps
+batches in HBM end to end, the analogue of the RapidsShuffleManager's
+device-store caching path (RapidsCachingWriter,
+RapidsShuffleInternalManager.scala:90-138); the mesh-collective
+exchange for true multi-chip runs lives in parallel/exchange.py.
+
+Partitionings: hash / single / round-robin run on device; range falls
+back to the host exchange (its reservoir-sample bounds are a host-side
+prepare step — GpuRangePartitioner.scala does the same sampling on the
+driver).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..data.column import DeviceBatch
+from ..ops.expression import as_device_column
+from ..ops.kernels.gather import compact
+from ..shuffle.partitioning import (HashPartitioning,
+                                    RoundRobinPartitioning,
+                                    SinglePartitioning)
+from ..utils import hashing
+from ..utils import metrics as M
+from ..utils.tracing import trace_range
+from .base import DevicePartitionedData, TpuExec
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    def __init__(self, child, plan):
+        super().__init__([child])
+        self.plan = plan  # physical.ShuffleExchangeExec
+        self.partitioning = plan.partitioning
+        self.n_out = plan.n_out
+        self._rr_next = 0
+        import jax
+
+        self._hash_kernel = jax.jit(self._hash_pids)
+        self._slice_kernel = jax.jit(self._slice)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    # ------------------------------------------------------------------
+    def _hash_pids(self, batch: DeviceBatch):
+        import jax.numpy as jnp
+
+        cols = [as_device_column(k.eval_tpu(batch), batch.padded_rows)
+                for k in self.partitioning._bound]
+        h = hashing.hash_device_batch(cols)
+        return hashing.pmod(h, self.n_out).astype(jnp.int32)
+
+    def _pids(self, batch: DeviceBatch):
+        import jax.numpy as jnp
+
+        if isinstance(self.partitioning, SinglePartitioning):
+            return jnp.zeros(batch.padded_rows, dtype=jnp.int32)
+        if isinstance(self.partitioning, RoundRobinPartitioning):
+            start = self._rr_next
+            self._rr_next = (start + int(batch.num_rows)) % self.n_out
+            return ((jnp.arange(batch.padded_rows, dtype=jnp.int32)
+                     + start) % self.n_out)
+        return self._hash_kernel(batch)
+
+    @staticmethod
+    def _slice(batch: DeviceBatch, pids, p) -> DeviceBatch:
+        return compact(batch, pids == p)
+
+    # ------------------------------------------------------------------
+    def execute_columnar(self, ctx):
+        child = self.children[0].execute_columnar(ctx)
+        self._init_metrics(ctx)
+        store: List[list] = []
+
+        def materialized():
+            if not store:
+                items = []
+                with trace_range("TpuShuffleWrite",
+                                 self.metrics[M.TOTAL_TIME]):
+                    for pid in range(child.n_partitions):
+                        for b in child.iterator(pid):
+                            if int(b.num_rows) == 0:
+                                continue
+                            items.append((b, self._pids(b)))
+                store.append(items)
+            return store[0]
+
+        def make(p):
+            def it():
+                import jax.numpy as jnp
+
+                for b, pids in materialized():
+                    out = self._slice_kernel(b, pids,
+                                             jnp.int32(p))
+                    if int(out.num_rows):
+                        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                        yield out
+
+            return it
+
+        return DevicePartitionedData([make(i) for i in range(self.n_out)])
+
+    def describe(self):
+        return f"TpuShuffleExchange[{self.partitioning.describe()}]"
+
+
+# ==========================================================================
+# rule registration
+# ==========================================================================
+def register(register_exec):
+    from ..plan import physical as P
+    from ..shuffle.partitioning import RangePartitioning
+
+    def tag(meta):
+        part = meta.plan.partitioning
+        if isinstance(part, RangePartitioning):
+            meta.will_not_work_on_tpu(
+                "range partitioning runs on the host engine "
+                "(driver-side sample bounds)")
+
+    def exprs_of(plan: P.ShuffleExchangeExec):
+        part = plan.partitioning
+        return list(getattr(part, "_bound", None)
+                    or getattr(part, "keys", []) or [])
+
+    register_exec(
+        P.ShuffleExchangeExec,
+        convert=lambda meta, ch: TpuShuffleExchangeExec(ch[0], meta.plan),
+        desc="device hash/single/round-robin exchange",
+        tag=tag,
+        exprs_of=exprs_of)
