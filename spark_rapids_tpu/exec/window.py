@@ -1,0 +1,311 @@
+"""Device window exec.
+
+Reference analogue: GpuWindowExec.scala:34-92 + GpuWindowExpression
+(cudf rolling-window ops).  cudf evaluates frames with per-row rolling
+kernels; the TPU formulation is scan-based over one global sort:
+
+  * one lexsort by (partition keys, order keys) groups every window
+    partition contiguously (same sort the reference's exchange+sort
+    would do),
+  * count/sum/avg over ANY rows frame become two gathers into an
+    exclusive prefix sum,
+  * min/max use segment-reset associative scans (unbounded ends) or a
+    statically-unrolled shifted reduction (bounded frames — frame
+    offsets are plan constants, so the width is a compile-time
+    constant),
+  * row_number/rank/dense_rank are index arithmetic on segment starts.
+
+Everything for all window expressions traces into ONE jitted program.
+Falls back to the host engine for string-typed frame aggregates,
+first/last over windows, and bounded frames wider than _MAX_WIDTH.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .. import types as T
+from ..data.column import DeviceBatch, DeviceColumn
+from ..ops.aggregates import AggregateFunction, Average, Count, Sum
+from ..ops.expression import as_device_column
+from ..ops.kernels import gather as G
+from ..ops.kernels import segment as seg
+from ..ops.windowexprs import (DenseRank, Rank, RowNumber,
+                               WindowExpression)
+from ..utils import metrics as M
+from ..utils.tracing import trace_range
+from .base import DevicePartitionedData, RequireSingleBatch, TpuExec
+
+_MAX_WIDTH = 256  # bounded-frame unroll cap; wider frames fall back
+
+
+def _supported_reason(wx: WindowExpression):
+    """None if the expression runs on device, else the fallback reason
+    (mirrors GpuWindowExpressionMeta tagging)."""
+    func = wx.func
+    if isinstance(func, (RowNumber, Rank, DenseRank)):
+        return None
+    if not isinstance(func, AggregateFunction):
+        return f"window function {type(func).__name__} not on device"
+    name = getattr(func, "name", type(func).__name__.lower())
+    if isinstance(func, (Count, Sum, Average)) or name in ("min", "max"):
+        child = func.child
+        if child is not None and child.dtype.id is T.TypeId.STRING \
+                and name in ("min", "max", "sum", "average", "avg"):
+            return "string window aggregates run on the host engine"
+        f = wx.spec.resolved_frame()
+        if f.lower is not None and f.upper is not None \
+                and name in ("min", "max") \
+                and (f.upper - f.lower + 1) > _MAX_WIDTH:
+            return (f"bounded min/max frame wider than {_MAX_WIDTH} "
+                    f"runs on the host engine")
+        return None
+    return f"window aggregate {name} runs on the host engine"
+
+
+def _seg_scan(comb_val, vals, seg_ids, reverse=False):
+    """Segment-reset associative scan: running reduce within each
+    contiguous segment."""
+    import jax
+    import jax.numpy as jnp
+
+    def comb(a, b):
+        va, sa = a
+        vb, sb = b
+        return (jnp.where(sb == sa, comb_val(va, vb), vb), sb)
+
+    out, _ = jax.lax.associative_scan(comb, (vals, seg_ids),
+                                      reverse=reverse)
+    return out
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, child, plan):
+        super().__init__([child])
+        self.plan = plan  # window_cpu.WindowExec (exprs already bound)
+        self.window_exprs = plan.window_exprs
+        self._schema = plan.schema
+        import jax
+
+        self._kernel = jax.jit(self._compute)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def children_coalesce_goal(self):
+        return [RequireSingleBatch()]
+
+    # ------------------------------------------------------------------
+    def _compute(self, batch: DeviceBatch) -> DeviceBatch:
+        import jax
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        rm = batch.row_mask()
+        out_cols = list(batch.columns)
+        for wx in self.window_exprs:
+            out_cols.append(self._one_window(batch, wx, n, rm))
+        return DeviceBatch(self._schema, out_cols, batch.num_rows)
+
+    def _one_window(self, batch, wx: WindowExpression, n, rm
+                    ) -> DeviceColumn:
+        import jax
+        import jax.numpy as jnp
+
+        spec = wx.spec
+        part_cols = [as_device_column(e.eval_tpu(batch), n)
+                     for e in spec.partition_by]
+        order_cols = [as_device_column(k.expr.eval_tpu(batch), n)
+                      for k in spec.order_by]
+        desc = [False] * len(part_cols) + \
+            [not k.ascending for k in spec.order_by]
+        nf = [True] * len(part_cols) + \
+            [k.nulls_first for k in spec.order_by]
+        all_cols = part_cols + order_cols
+        if all_cols:
+            order = seg.lexsort_device(all_cols, desc, nf, pad_valid=rm)
+        else:
+            order = jnp.arange(n, dtype=jnp.int32)
+        rm_s = rm[order]
+        if part_cols:
+            sorted_parts = [G.gather_column(c, order) for c in part_cols]
+            seg_ids = seg.segment_ids_device(sorted_parts, pad_valid=rm_s)
+        else:
+            # padding rows still need their own segments
+            seg_ids = jnp.where(
+                rm_s, 0,
+                jnp.arange(n, dtype=jnp.int32) + 1).astype(jnp.int32)
+
+        idx = jnp.arange(n, dtype=jnp.int64)
+        seg_start = jax.ops.segment_min(idx, seg_ids, num_segments=n)[
+            seg_ids].astype(jnp.int32)
+        seg_end = (jax.ops.segment_max(idx, seg_ids, num_segments=n)[
+            seg_ids] + 1).astype(jnp.int32)
+
+        func = wx.func
+        i32 = jnp.arange(n, dtype=jnp.int32)
+        if isinstance(func, RowNumber):
+            data = (i32 - seg_start + 1).astype(jnp.int32)
+            valid = rm_s
+        elif isinstance(func, (Rank, DenseRank)):
+            if order_cols:
+                sorted_all = [G.gather_column(c, order) for c in all_cols]
+                ok_ids = seg.segment_ids_device(sorted_all,
+                                                pad_valid=rm_s)
+            else:  # no ordering: every row is its own tie group
+                ok_ids = i32
+            ok_start = jax.ops.segment_min(idx, ok_ids, num_segments=n)[
+                ok_ids].astype(jnp.int32)
+            if isinstance(func, Rank):
+                data = (ok_start - seg_start + 1).astype(jnp.int32)
+            else:
+                first_ok_of_seg = ok_ids[jnp.clip(seg_start, 0, n - 1)]
+                data = (ok_ids - first_ok_of_seg + 1).astype(jnp.int32)
+            valid = rm_s
+        else:
+            data, valid = self._frame_agg(batch, wx, order, rm_s,
+                                          seg_ids, seg_start, seg_end, n)
+
+        # scatter back to original row order
+        inv = jnp.zeros((n,), dtype=jnp.int32).at[order].set(i32)
+        out_dtype = wx.dtype
+        data = data[inv]
+        if data.dtype != out_dtype.jnp_dtype:
+            data = data.astype(out_dtype.jnp_dtype)
+        return DeviceColumn(out_dtype, data, valid[inv] & rm)
+
+    # ------------------------------------------------------------------
+    def _frame_agg(self, batch, wx, order, rm_s, seg_ids, seg_start,
+                   seg_end, n):
+        import jax
+        import jax.numpy as jnp
+
+        func = wx.func
+        frame = wx.spec.resolved_frame()
+        child = func.child
+        if child is None:  # count(*)
+            vals = jnp.ones((n,), dtype=jnp.int64)
+            valid = rm_s
+        else:
+            c = as_device_column(child.eval_tpu(batch), n)
+            vals = c.data[order]
+            valid = c.validity[order] & rm_s
+
+        i32 = jnp.arange(n, dtype=jnp.int32)
+        # frame [lo, hi) clamped to the segment (host oracle semantics)
+        if frame.lower is None:
+            lo = seg_start
+        else:
+            lo = jnp.clip(i32 + frame.lower, seg_start, seg_end)
+        if frame.upper is None:
+            hi = seg_end
+        else:
+            hi = jnp.clip(i32 + frame.upper + 1, seg_start, seg_end)
+        hi = jnp.maximum(hi, lo)
+
+        name = getattr(func, "name", "")
+        cntP = jnp.concatenate([jnp.zeros((1,), jnp.int64),
+                                jnp.cumsum(valid.astype(jnp.int64))])
+        cnt = cntP[hi] - cntP[lo]
+        if isinstance(func, Count):
+            return cnt, jnp.ones((n,), dtype=jnp.bool_)
+        if isinstance(func, (Sum, Average)):
+            acc_t = jnp.float64 \
+                if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.int64
+            z = jnp.where(valid, vals, 0).astype(acc_t)
+            sumP = jnp.concatenate([jnp.zeros((1,), acc_t),
+                                    jnp.cumsum(z)])
+            s = sumP[hi] - sumP[lo]
+            if isinstance(func, Average):
+                s = s.astype(jnp.float64) / jnp.maximum(cnt, 1)
+            return s, cnt > 0
+        # min / max
+        is_min = name == "min"
+        if jnp.issubdtype(vals.dtype, jnp.floating):
+            ident = jnp.asarray(jnp.inf if is_min else -jnp.inf,
+                                vals.dtype)
+        else:
+            info = jnp.iinfo(vals.dtype)
+            ident = jnp.asarray(info.max if is_min else info.min,
+                                vals.dtype)
+        masked = jnp.where(valid, vals, ident)
+        comb = jnp.minimum if is_min else jnp.maximum
+        if frame.lower is None and frame.upper is None:
+            fn = jax.ops.segment_min if is_min else jax.ops.segment_max
+            per_seg = fn(masked, seg_ids, num_segments=n)
+            return per_seg[seg_ids], cnt > 0
+        if frame.lower is None:
+            run = _seg_scan(comb, masked, seg_ids)          # [start, i]
+            out = run[jnp.clip(hi - 1, 0, n - 1)]
+            return out, cnt > 0
+        if frame.upper is None:
+            run = _seg_scan(comb, masked, seg_ids, reverse=True)
+            out = run[jnp.clip(lo, 0, n - 1)]               # [i, end)
+            return out, cnt > 0
+        # bounded both: static unroll over the frame width
+        out = jnp.full((n,), ident, vals.dtype)
+        for d in range(frame.lower, frame.upper + 1):
+            j = i32 + d
+            ok = (j >= lo) & (j < hi)
+            v = masked[jnp.clip(j, 0, n - 1)]
+            out = comb(out, jnp.where(ok, v, ident))
+        return out, cnt > 0
+
+    # ------------------------------------------------------------------
+    def execute_columnar(self, ctx):
+        child = self.children[0].execute_columnar(ctx)
+        self._init_metrics(ctx)
+
+        def make(pid):
+            def it():
+                batches = list(child.iterator(pid))
+                if not batches:
+                    return
+                from .coalesce import concat_device_batches
+
+                batch = concat_device_batches(batches) \
+                    if len(batches) > 1 else batches[0]
+                with trace_range("TpuWindow",
+                                 self.metrics[M.TOTAL_TIME]):
+                    out = self._kernel(batch)
+                self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                yield out
+
+            return it
+
+        return DevicePartitionedData(
+            [make(i) for i in range(child.n_partitions)])
+
+    def describe(self):
+        return (f"TpuWindow[{', '.join(w.sql() for w in self.window_exprs)}]")
+
+
+# ==========================================================================
+# rule registration
+# ==========================================================================
+def register(register_exec):
+    from .window_cpu import WindowExec
+
+    def tag(meta):
+        for wx in meta.plan.window_exprs:
+            reason = _supported_reason(wx)
+            if reason:
+                meta.will_not_work_on_tpu(reason)
+
+    def exprs_of(plan):
+        out = []
+        for wx in plan.window_exprs:
+            out.extend(wx.spec.partition_by)
+            out.extend(k.expr for k in wx.spec.order_by)
+            if isinstance(wx.func, AggregateFunction) \
+                    and wx.func.child is not None:
+                out.append(wx.func.child)
+        return out
+
+    register_exec(
+        WindowExec,
+        convert=lambda meta, ch: TpuWindowExec(ch[0], meta.plan),
+        desc="scan-based window functions on TPU",
+        tag=tag,
+        exprs_of=exprs_of)

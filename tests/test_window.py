@@ -1,0 +1,108 @@
+"""Device window functions vs CPU oracle (reference analogue:
+WindowFunctionSuite.scala)."""
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import f
+from spark_rapids_tpu.ops.windowexprs import (dense_rank, over, rank,
+                                              row_number, window)
+
+
+DATA = {
+    "k": [1, 1, 1, 2, 2, None, 1, 2, 2, 1],
+    "t": [3, 1, 2, 5, 4, 1, 1, 4, None, 9],
+    "v": [1.0, 2.0, None, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0],
+}
+
+
+def _norm(rows):
+    out = []
+    for r in rows:
+        out.append(tuple(round(v, 9) if isinstance(v, float) else v
+                         for v in r))
+    return sorted(out, key=repr)
+
+
+def _run_both(wexpr_builder, expect_tpu=True, data=DATA):
+    tpu = srt.Session()
+    cpu = srt.Session(tpu_enabled=False)
+    outs = []
+    for sess in (tpu, cpu):
+        df = sess.create_dataframe(data, n_partitions=2)
+        q = df.with_window("w", wexpr_builder())
+        if sess is tpu and expect_tpu:
+            ex = q.explain()
+            assert "WindowExec -> will run on TPU" in ex, ex
+        outs.append(_norm(q.collect()))
+    assert outs[0] == outs[1], f"\nTPU: {outs[0]}\nCPU: {outs[1]}"
+
+
+def test_row_number():
+    _run_both(lambda: over(
+        row_number(), window().partition_by("k").order_by("t")))
+
+
+def test_rank_dense_rank():
+    data = {"k": [1, 1, 1, 1, 2, 2, 2],
+            "t": [1, 1, 2, 3, 5, 5, 5],
+            "v": [1.0] * 7}
+    _run_both(lambda: over(
+        rank(), window().partition_by("k").order_by("t")), data=data)
+    _run_both(lambda: over(
+        dense_rank(), window().partition_by("k").order_by("t")),
+        data=data)
+
+
+@pytest.mark.parametrize("agg", ["sum", "count", "avg", "min", "max"])
+def test_unbounded_window_aggs(agg):
+    fn = getattr(f, agg)
+    _run_both(lambda: over(fn("v"), window().partition_by("k")))
+
+
+@pytest.mark.parametrize("agg", ["sum", "count", "min", "max"])
+def test_running_window_aggs(agg):
+    fn = getattr(f, agg)
+    _run_both(lambda: over(
+        fn("v"),
+        window().partition_by("k").order_by("t")
+        .rows_between(None, 0)))
+
+
+@pytest.mark.parametrize("agg", ["sum", "min", "max", "count"])
+def test_bounded_window_aggs(agg):
+    fn = getattr(f, agg)
+    _run_both(lambda: over(
+        fn("v"),
+        window().partition_by("k").order_by("t").rows_between(-1, 1)))
+
+
+def test_window_reverse_running():
+    _run_both(lambda: over(
+        f.max("v"),
+        window().partition_by("k").order_by("t").rows_between(0, None)))
+
+
+def test_window_desc_order_and_large():
+    rng = np.random.RandomState(17)
+    data = {"k": rng.randint(0, 10, 400).tolist(),
+            "t": rng.randint(0, 1000, 400).tolist(),
+            "v": rng.rand(400).tolist()}
+    _run_both(lambda: over(
+        f.sum("v"),
+        window().partition_by("k").order_by(f.col("t").desc())
+        .rows_between(None, 0)), data=data)
+
+
+def test_string_window_agg_falls_back():
+    data = {"k": [1, 1, 2], "s": ["a", "b", "c"]}
+    sess = srt.Session()
+    df = sess.create_dataframe(data)
+    q = df.with_window("w", over(f.min("s"),
+                                 window().partition_by("k")))
+    ex = q.explain()
+    assert "cannot run on TPU" in ex
+    cpu = srt.Session(tpu_enabled=False)
+    cq = cpu.create_dataframe(data).with_window(
+        "w", over(f.min("s"), window().partition_by("k")))
+    assert _norm(q.collect()) == _norm(cq.collect())
