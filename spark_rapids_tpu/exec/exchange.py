@@ -39,7 +39,6 @@ class TpuShuffleExchangeExec(TpuExec):
         self.plan = plan  # physical.ShuffleExchangeExec
         self.partitioning = plan.partitioning
         self.n_out = plan.n_out
-        self._rr_next = 0
         import jax
 
         self._hash_kernel = jax.jit(self._hash_pids)
@@ -58,16 +57,14 @@ class TpuShuffleExchangeExec(TpuExec):
         h = hashing.hash_device_batch(cols)
         return hashing.pmod(h, self.n_out).astype(jnp.int32)
 
-    def _pids(self, batch: DeviceBatch):
+    def _pids(self, batch: DeviceBatch, rr_start: int = 0):
         import jax.numpy as jnp
 
         if isinstance(self.partitioning, SinglePartitioning):
             return jnp.zeros(batch.padded_rows, dtype=jnp.int32)
         if isinstance(self.partitioning, RoundRobinPartitioning):
-            start = self._rr_next
-            self._rr_next = (start + int(batch.num_rows)) % self.n_out
             return ((jnp.arange(batch.padded_rows, dtype=jnp.int32)
-                     + start) % self.n_out)
+                     + rr_start) % self.n_out)
         return self._hash_kernel(batch)
 
     @staticmethod
@@ -76,20 +73,29 @@ class TpuShuffleExchangeExec(TpuExec):
 
     # ------------------------------------------------------------------
     def execute_columnar(self, ctx):
+        from ..memory.spill import SpillFramework
+
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
         store: List[list] = []
+        fw = SpillFramework.get()
 
         def materialized():
+            """Shuffle write: batches registered as spillable in the
+            device store (reference: RapidsCachingWriter keeps map
+            output in HBM, spillable under pressure)."""
             if not store:
-                items = []
+                items = []  # (buffer id, round-robin start offset)
+                rr = 0
                 with trace_range("TpuShuffleWrite",
                                  self.metrics[M.TOTAL_TIME]):
                     for pid in range(child.n_partitions):
                         for b in child.iterator(pid):
-                            if int(b.num_rows) == 0:
+                            n = int(b.num_rows)
+                            if n == 0:
                                 continue
-                            items.append((b, self._pids(b)))
+                            items.append((fw.add_batch(b), rr))
+                            rr = (rr + n) % self.n_out
                 store.append(items)
             return store[0]
 
@@ -97,9 +103,13 @@ class TpuShuffleExchangeExec(TpuExec):
             def it():
                 import jax.numpy as jnp
 
-                for b, pids in materialized():
-                    out = self._slice_kernel(b, pids,
-                                             jnp.int32(p))
+                for buf_id, rr_start in materialized():
+                    b = fw.acquire_batch(buf_id)
+                    try:
+                        out = self._slice_kernel(
+                            b, self._pids(b, rr_start), jnp.int32(p))
+                    finally:
+                        fw.release_batch(buf_id)
                     if int(out.num_rows):
                         self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
                         yield out

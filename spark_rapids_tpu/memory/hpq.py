@@ -1,0 +1,63 @@
+"""Hashed priority queue: O(log n) push/pop, O(1) membership, stable
+priority updates via lazy invalidation.
+
+Reference analogue: HashedPriorityQueue.java (the spill queue — 300 LoC
+of hand-rolled heap + hash map; Python's heapq + dict gives the same
+contract).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class HashedPriorityQueue:
+    """Min-heap by (priority, insertion order) with O(1) contains and
+    remove/update by key."""
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, Any]] = []
+        self._entries: Dict[Any, Tuple[float, int, Any]] = {}
+        self._counter = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def push(self, key, priority: float) -> None:
+        if key in self._entries:
+            self.remove(key)
+        entry = (priority, next(self._counter), key)
+        self._entries[key] = entry
+        heapq.heappush(self._heap, entry)
+
+    def remove(self, key) -> bool:
+        return self._entries.pop(key, None) is not None
+
+    def update_priority(self, key, priority: float) -> None:
+        self.push(key, priority)
+
+    def peek(self) -> Optional[Any]:
+        self._prune()
+        return self._heap[0][2] if self._heap else None
+
+    def pop(self) -> Optional[Any]:
+        self._prune()
+        if not self._heap:
+            return None
+        _, _, key = heapq.heappop(self._heap)
+        del self._entries[key]
+        return key
+
+    def priority_of(self, key) -> Optional[float]:
+        e = self._entries.get(key)
+        return e[0] if e else None
+
+    def _prune(self) -> None:
+        # drop heap entries whose key was removed or re-pushed
+        while self._heap and self._entries.get(
+                self._heap[0][2]) is not self._heap[0]:
+            heapq.heappop(self._heap)

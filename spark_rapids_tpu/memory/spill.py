@@ -1,0 +1,373 @@
+"""Spill framework: catalog + device/host/disk tiers + alloc-pressure
+handler.
+
+Reference analogue (SURVEY §2.7): RapidsBufferCatalog (id→buffer with
+refcounts), RapidsBuffer/StorageTier (DEVICE=0/HOST=1/DISK=2,
+RapidsBuffer.scala:53-58), RapidsBufferStore.synchronousSpill
+(RapidsBufferStore.scala:148-188), RapidsDeviceMemoryStore /
+RapidsHostMemoryStore / RapidsDiskStore, SpillPriorities.scala, and
+DeviceMemoryEventHandler (alloc failure → spill until the allocation
+can succeed).
+
+TPU mapping: a DEVICE buffer is a DeviceBatch (jax arrays in HBM);
+spilling device→host is a device_to_host copy (numpy), host→disk is an
+.npz file under a spill directory.  Re-acquiring a spilled buffer at
+DEVICE re-uploads and promotes it back.  There is no RMM callback to
+intercept — the DeviceManager's logical-arena accounting calls
+``on_alloc_failure`` when tracked usage crosses the arena size, the
+same contract the reference's event handler has.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+from enum import IntEnum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..data.column import (DeviceBatch, HostBatch, HostColumn,
+                           device_to_host, host_to_device)
+from .. import types as T
+from .hpq import HashedPriorityQueue
+
+log = logging.getLogger(__name__)
+
+
+class StorageTier(IntEnum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+class SpillPriorities:
+    """Priority bands (lower spills first — SpillPriorities.scala:26-50:
+    shuffle output awaiting read spills first with timestamp decay;
+    active shuffle input spills last)."""
+
+    OUTPUT_FOR_READ_BASE = 0.0
+    ACTIVE_ON_DECK = 1e12
+    INPUT_MAX = float("inf")
+
+    @staticmethod
+    def output_for_read() -> float:
+        # older outputs spill earlier
+        return SpillPriorities.OUTPUT_FOR_READ_BASE + time.monotonic()
+
+
+class SpillableBuffer:
+    """One spillable batch.  The payload lives on exactly one tier;
+    schema/meta stay on the host (reference: TableMeta rides with the
+    buffer through every tier)."""
+
+    def __init__(self, buf_id: int, batch: DeviceBatch, priority: float,
+                 size_bytes: Optional[int] = None):
+        self.id = buf_id
+        self.tier = StorageTier.DEVICE
+        self.priority = priority
+        self.schema = batch.schema
+        self.size = size_bytes if size_bytes is not None \
+            else batch.device_bytes()
+        self._device: Optional[DeviceBatch] = batch
+        self._host: Optional[HostBatch] = None
+        self._disk_path: Optional[str] = None
+        self._min_bucket = max(batch.padded_rows, 1)
+        self.refcount = 0
+        self.lock = threading.Lock()
+
+    # ----- tier movement ---------------------------------------------------
+    def to_host(self) -> None:
+        assert self.tier == StorageTier.DEVICE
+        self._host = device_to_host(self._device)
+        self._device = None
+        self.tier = StorageTier.HOST
+
+    def to_disk(self, directory: str) -> None:
+        assert self.tier == StorageTier.HOST
+        path = os.path.join(directory, f"buffer-{self.id}.npz")
+        arrays = {}
+        for i, c in enumerate(self._host.columns):
+            if c.dtype.id is T.TypeId.STRING:
+                arrays[f"d{i}"] = np.array(
+                    ["" if v is None else v for v in c.data], dtype=object)
+            else:
+                arrays[f"d{i}"] = c.data
+            arrays[f"v{i}"] = c.is_valid()
+        np.savez(path, allow_pickle=True, **arrays)
+        self._disk_path = path
+        self._host = None
+        self.tier = StorageTier.DISK
+
+    def _load_host(self) -> HostBatch:
+        if self.tier == StorageTier.HOST:
+            return self._host
+        assert self.tier == StorageTier.DISK
+        with np.load(self._disk_path, allow_pickle=True) as z:
+            cols = []
+            for i, f in enumerate(self.schema):
+                data = z[f"d{i}"]
+                valid = z[f"v{i}"]
+                if f.dtype.id is T.TypeId.STRING:
+                    data = np.array([v if ok else None
+                                     for v, ok in zip(data, valid)],
+                                    dtype=object)
+                cols.append(HostColumn(
+                    f.dtype, data,
+                    None if valid.all() else valid))
+        return HostBatch(self.schema, cols)
+
+    def get_device_batch(self) -> DeviceBatch:
+        """Materialize at DEVICE tier (re-upload + promote if spilled)."""
+        if self.tier == StorageTier.DEVICE:
+            return self._device
+        hb = self._load_host()
+        db = host_to_device(hb, min_bucket_rows=self._min_bucket)
+        self._device = db
+        self._host = None
+        if self._disk_path and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)
+        self._disk_path = None
+        self.tier = StorageTier.DEVICE
+        return db
+
+    def free(self) -> None:
+        self._device = None
+        self._host = None
+        if self._disk_path and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)
+        self._disk_path = None
+
+
+class BufferCatalog:
+    """id → buffer with refcount acquire/release (reference:
+    RapidsBufferCatalog.scala:30-104)."""
+
+    def __init__(self):
+        self._buffers: Dict[int, SpillableBuffer] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def next_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def register(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            self._buffers[buf.id] = buf
+
+    def acquire(self, buf_id: int) -> SpillableBuffer:
+        with self._lock:
+            buf = self._buffers[buf_id]
+            buf.refcount += 1
+            return buf
+
+    def release(self, buf_id: int) -> None:
+        with self._lock:
+            self._buffers[buf_id].refcount -= 1
+
+    def remove(self, buf_id: int) -> None:
+        with self._lock:
+            buf = self._buffers.pop(buf_id, None)
+        if buf is not None:
+            buf.free()
+
+    def get(self, buf_id: int) -> Optional[SpillableBuffer]:
+        return self._buffers.get(buf_id)
+
+    def ids(self) -> List[int]:
+        return list(self._buffers.keys())
+
+
+class SpillFramework:
+    """Wires the tiers: device → host → disk, with the priority queue
+    choosing victims (reference: GpuShuffleEnv.initStorage chaining
+    stores, GpuShuffleEnv.scala:61-66)."""
+
+    _instance: Optional["SpillFramework"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self, host_limit_bytes: int = 1 << 30,
+                 spill_dir: Optional[str] = None,
+                 device_limit_bytes: Optional[int] = None):
+        self.catalog = BufferCatalog()
+        self.device_queue = HashedPriorityQueue()
+        self.host_queue = HashedPriorityQueue()
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self.host_limit = host_limit_bytes
+        self.device_limit = device_limit_bytes
+        self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="srt-spill-")
+        self._lock = threading.RLock()
+        self.metrics = {"spill_to_host": 0, "spill_to_disk": 0,
+                        "bytes_spilled": 0}
+
+    # ----- singleton -------------------------------------------------------
+    @classmethod
+    def get(cls) -> "SpillFramework":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = SpillFramework()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._ilock:
+            cls._instance = None
+
+    # ----- store API -------------------------------------------------------
+    def add_batch(self, batch: DeviceBatch,
+                  priority: Optional[float] = None) -> int:
+        """Register a device batch as spillable; returns its id
+        (reference: RapidsDeviceMemoryStore.addTable)."""
+        with self._lock:
+            buf = SpillableBuffer(
+                self.catalog.next_id(), batch,
+                SpillPriorities.output_for_read()
+                if priority is None else priority)
+            self.catalog.register(buf)
+            self.device_queue.push(buf.id, buf.priority)
+            self.device_bytes += buf.size
+            if self.device_limit is not None \
+                    and self.device_bytes > self.device_limit:
+                self.spill_device_to_target(self.device_limit)
+            return buf.id
+
+    def acquire_batch(self, buf_id: int) -> DeviceBatch:
+        """Pin + materialize on device (promotes spilled buffers)."""
+        buf = self.catalog.acquire(buf_id)
+        with self._lock:
+            prev_tier = buf.tier
+            db = buf.get_device_batch()
+            if prev_tier != StorageTier.DEVICE:
+                if prev_tier == StorageTier.HOST:
+                    self.host_bytes -= buf.size
+                    self.host_queue.remove(buf.id)
+                self.device_bytes += buf.size
+                self.device_queue.push(buf.id, buf.priority)
+            return db
+
+    def release_batch(self, buf_id: int) -> None:
+        self.catalog.release(buf_id)
+
+    def remove_batch(self, buf_id: int) -> None:
+        with self._lock:
+            buf = self.catalog.get(buf_id)
+            if buf is None:
+                return
+            if buf.tier == StorageTier.DEVICE:
+                self.device_bytes -= buf.size
+                self.device_queue.remove(buf.id)
+            elif buf.tier == StorageTier.HOST:
+                self.host_bytes -= buf.size
+                self.host_queue.remove(buf.id)
+            self.catalog.remove(buf_id)
+
+    # ----- spilling --------------------------------------------------------
+    def spill_device_to_target(self, target_bytes: int) -> int:
+        """Spill lowest-priority unpinned device buffers until device
+        usage <= target (reference: RapidsBufferStore.synchronousSpill).
+        Returns bytes spilled."""
+        spilled = 0
+        with self._lock:
+            while self.device_bytes > target_bytes:
+                victim_id = self._pick_device_victim()
+                if victim_id is None:
+                    break  # everything pinned
+                buf = self.catalog.get(victim_id)
+                self.device_queue.remove(victim_id)
+                buf.to_host()
+                self.device_bytes -= buf.size
+                self.host_bytes += buf.size
+                self.host_queue.push(buf.id, buf.priority)
+                spilled += buf.size
+                self.metrics["spill_to_host"] += 1
+                self.metrics["bytes_spilled"] += buf.size
+                self._maybe_spill_host_to_disk()
+        if spilled:
+            log.info("spilled %d bytes device->host", spilled)
+        return spilled
+
+    def _pick_device_victim(self) -> Optional[int]:
+        # lowest priority, skipping pinned buffers
+        skipped = []
+        victim = None
+        while True:
+            vid = self.device_queue.pop()
+            if vid is None:
+                break
+            buf = self.catalog.get(vid)
+            if buf is None:
+                continue
+            if buf.refcount > 0:
+                skipped.append((vid, buf.priority))
+                continue
+            victim = vid
+            break
+        for vid, pri in skipped:
+            self.device_queue.push(vid, pri)
+        if victim is not None:
+            # re-add so caller's remove() bookkeeping stays uniform
+            self.device_queue.push(
+                victim, self.catalog.get(victim).priority)
+        return victim
+
+    def _maybe_spill_host_to_disk(self) -> None:
+        while self.host_bytes > self.host_limit:
+            vid = self.host_queue.pop()
+            if vid is None:
+                break
+            buf = self.catalog.get(vid)
+            if buf is None:
+                continue
+            if buf.refcount > 0:
+                continue
+            buf.to_disk(self.spill_dir)
+            self.host_bytes -= buf.size
+            self.metrics["spill_to_disk"] += 1
+
+
+class MemoryEventHandler:
+    """Alloc-pressure → synchronous spill (reference:
+    DeviceMemoryEventHandler.scala:65-89).  Installed on the
+    DeviceManager; fired when tracked usage crosses the arena size."""
+
+    def __init__(self, framework: SpillFramework, arena_bytes: int,
+                 spill_fraction: float = 0.8):
+        self.framework = framework
+        self.arena_bytes = arena_bytes
+        self.spill_fraction = spill_fraction
+
+    def on_alloc_failure(self, requested: int, allocated: int) -> bool:
+        target = max(0, int(self.arena_bytes * self.spill_fraction)
+                     - requested)
+        return self.framework.spill_device_to_target(target) > 0
+
+    def on_alloc_threshold(self, over_bytes: int) -> bool:
+        """DeviceManager.track_alloc hook: arena overflowed by
+        ``over_bytes``; free at least that much from the device tier."""
+        target = max(0, self.framework.device_bytes - over_bytes)
+        return self.framework.spill_device_to_target(target) > 0
+
+
+def install(device_manager, conf=None) -> SpillFramework:
+    """Create/fetch the framework and hook it to the device manager's
+    alloc accounting (reference: GpuShuffleEnv.initStorage +
+    Rmm.setEventHandler)."""
+    from ..config import HOST_SPILL_STORAGE_SIZE
+
+    with SpillFramework._ilock:
+        if SpillFramework._instance is None:
+            host_limit = conf.get(HOST_SPILL_STORAGE_SIZE) if conf \
+                else 1 << 30
+            SpillFramework._instance = SpillFramework(
+                host_limit_bytes=host_limit,
+                device_limit_bytes=device_manager.arena_bytes)
+        fw = SpillFramework._instance
+    if device_manager.event_handler is None:
+        device_manager.event_handler = MemoryEventHandler(
+            fw, device_manager.arena_bytes)
+    return fw

@@ -41,10 +41,14 @@ class Session:
         self.capture_plans = False
         if self.conf.is_sql_enabled:
             from .memory.device_manager import DeviceManager
+            from .memory.spill import install as install_spill
 
             self.device_manager = DeviceManager.get_or_create(self.conf)
+            self.spill_framework = install_spill(self.device_manager,
+                                                 self.conf)
         else:
             self.device_manager = None
+            self.spill_framework = None
         Session._active = self
 
     # ----- data sources ----------------------------------------------------
