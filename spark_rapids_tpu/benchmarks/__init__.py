@@ -1,0 +1,2 @@
+"""Benchmarks-as-code (reference: integration_tests/src/main/scala —
+TpchLikeSpark.scala, TpcxbbLikeSpark.scala, MortgageSpark.scala)."""

@@ -33,6 +33,11 @@ from ..utils.tracing import trace_range
 from .base import DevicePartitionedData, TpuExec
 
 
+def _free_shuffle_buffers(fw, store):
+    for buf_id, _rr in (store[0] if store else ()):
+        fw.remove_batch(buf_id)
+
+
 class TpuShuffleExchangeExec(TpuExec):
     def __init__(self, child, plan):
         super().__init__([child])
@@ -73,11 +78,17 @@ class TpuShuffleExchangeExec(TpuExec):
 
     # ------------------------------------------------------------------
     def execute_columnar(self, ctx):
+        import weakref
+
         from ..memory.spill import SpillFramework
 
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
         store: List[list] = []
+        # buf_id -> (id(device_batch), pids): partition ids are computed
+        # once per resident batch and reused by all n_out readers; a
+        # spill+promote cycle yields a new batch object and recomputes
+        pid_cache: dict = {}
         fw = SpillFramework.get()
 
         def materialized():
@@ -99,6 +110,14 @@ class TpuShuffleExchangeExec(TpuExec):
                 store.append(items)
             return store[0]
 
+        def pids_of(buf_id, b, rr_start):
+            cached = pid_cache.get(buf_id)
+            if cached is not None and cached[0] == id(b):
+                return cached[1]
+            pids = self._pids(b, rr_start)
+            pid_cache[buf_id] = (id(b), pids)
+            return pids
+
         def make(p):
             def it():
                 import jax.numpy as jnp
@@ -107,7 +126,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     b = fw.acquire_batch(buf_id)
                     try:
                         out = self._slice_kernel(
-                            b, self._pids(b, rr_start), jnp.int32(p))
+                            b, pids_of(buf_id, b, rr_start), jnp.int32(p))
                     finally:
                         fw.release_batch(buf_id)
                     if int(out.num_rows):
@@ -116,7 +135,13 @@ class TpuShuffleExchangeExec(TpuExec):
 
             return it
 
-        return DevicePartitionedData([make(i) for i in range(self.n_out)])
+        result = DevicePartitionedData([make(i) for i in range(self.n_out)])
+        # free the shuffle buffers from the global catalog when the read
+        # side is dropped (reference: per-shuffle cleanup in
+        # ShuffleBufferCatalog; without this every query's shuffle data
+        # stays resident for the life of the process)
+        weakref.finalize(result, _free_shuffle_buffers, fw, store)
+        return result
 
     def describe(self):
         return f"TpuShuffleExchange[{self.partitioning.describe()}]"

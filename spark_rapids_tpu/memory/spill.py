@@ -96,7 +96,7 @@ class SpillableBuffer:
             else:
                 arrays[f"d{i}"] = c.data
             arrays[f"v{i}"] = c.is_valid()
-        np.savez(path, allow_pickle=True, **arrays)
+        np.savez(path, **arrays)
         self._disk_path = path
         self._host = None
         self.tier = StorageTier.DISK
@@ -204,6 +204,19 @@ class SpillFramework:
         self._lock = threading.RLock()
         self.metrics = {"spill_to_host": 0, "spill_to_disk": 0,
                         "bytes_spilled": 0}
+        #: DeviceManager whose logical arena mirrors this framework's
+        #: device tier (set by install()); every device-tier byte delta is
+        #: reported so the alloc-pressure handler can fire.
+        self.device_manager = None
+
+    def _track_device(self, delta: int) -> None:
+        dm = self.device_manager
+        if dm is None:
+            return
+        if delta >= 0:
+            dm.track_alloc(delta)
+        else:
+            dm.track_free(-delta)
 
     # ----- singleton -------------------------------------------------------
     @classmethod
@@ -231,6 +244,7 @@ class SpillFramework:
             self.catalog.register(buf)
             self.device_queue.push(buf.id, buf.priority)
             self.device_bytes += buf.size
+            self._track_device(buf.size)
             if self.device_limit is not None \
                     and self.device_bytes > self.device_limit:
                 self.spill_device_to_target(self.device_limit)
@@ -248,6 +262,12 @@ class SpillFramework:
                     self.host_queue.remove(buf.id)
                 self.device_bytes += buf.size
                 self.device_queue.push(buf.id, buf.priority)
+                self._track_device(buf.size)
+                # promotion is an allocation too: enforce the device limit
+                # (the promoted buffer itself is pinned, so it is skipped)
+                if self.device_limit is not None \
+                        and self.device_bytes > self.device_limit:
+                    self.spill_device_to_target(self.device_limit)
             return db
 
     def release_batch(self, buf_id: int) -> None:
@@ -261,6 +281,7 @@ class SpillFramework:
             if buf.tier == StorageTier.DEVICE:
                 self.device_bytes -= buf.size
                 self.device_queue.remove(buf.id)
+                self._track_device(-buf.size)
             elif buf.tier == StorageTier.HOST:
                 self.host_bytes -= buf.size
                 self.host_queue.remove(buf.id)
@@ -281,6 +302,7 @@ class SpillFramework:
                 self.device_queue.remove(victim_id)
                 buf.to_host()
                 self.device_bytes -= buf.size
+                self._track_device(-buf.size)
                 self.host_bytes += buf.size
                 self.host_queue.push(buf.id, buf.priority)
                 spilled += buf.size
@@ -367,6 +389,7 @@ def install(device_manager, conf=None) -> SpillFramework:
                 host_limit_bytes=host_limit,
                 device_limit_bytes=device_manager.arena_bytes)
         fw = SpillFramework._instance
+    fw.device_manager = device_manager
     if device_manager.event_handler is None:
         device_manager.event_handler = MemoryEventHandler(
             fw, device_manager.arena_bytes)

@@ -192,6 +192,18 @@ class AggregateExpression(Expression):
         self.func = func
         self.mode = mode  # complete | partial | final
 
+    def with_children(self, children):
+        # keep func.child in sync so expression transforms (notably
+        # bind_references) reach through the wrapper into the function
+        node = super().with_children(children)
+        if node.func.child is not None:
+            import copy
+
+            f = copy.copy(node.func)
+            f.child = children[0]
+            node.func = f
+        return node
+
     @property
     def dtype(self):
         return self.func.dtype

@@ -161,6 +161,12 @@ class Literal(Expression):
         super().__init__()
         if dtype is None:
             dtype = _infer_literal_type(value)
+        if dtype.id is T.TypeId.DATE32:
+            import datetime as _dt
+            if isinstance(value, _dt.datetime):
+                value = value.date()
+            if isinstance(value, _dt.date):
+                value = (value - _dt.date(1970, 1, 1)).days
         self._dtype = dtype
         self.value = value
 
@@ -183,10 +189,13 @@ class Literal(Expression):
 
 
 def _infer_literal_type(v) -> T.DType:
+    import datetime as _dt
     if v is None:
         return T.NULL
     if isinstance(v, bool):
         return T.BOOL
+    if isinstance(v, _dt.date) and not isinstance(v, _dt.datetime):
+        return T.DATE32
     if isinstance(v, (int, np.integer)):
         return T.INT32 if -(2 ** 31) <= int(v) < 2 ** 31 else T.INT64
     if isinstance(v, (float, np.floating)):

@@ -399,30 +399,6 @@ minute = _u(dt.Minute)
 second = _u(dt.Second)
 
 
-def year(c) -> Column:
-    return Column(dt.Year(_col_e(c)))
-
-
-def month(c) -> Column:
-    return Column(dt.Month(_col_e(c)))
-
-
-def dayofmonth(c) -> Column:
-    return Column(dt.DayOfMonth(_col_e(c)))
-
-
-def hour(c) -> Column:
-    return Column(dt.Hour(_col_e(c)))
-
-
-def minute(c) -> Column:
-    return Column(dt.Minute(_col_e(c)))
-
-
-def second(c) -> Column:
-    return Column(dt.Second(_col_e(c)))
-
-
 def date_add(c, days) -> Column:
     return Column(dt.DateAdd(_col_e(c), _e(days)))
 

@@ -1,0 +1,47 @@
+"""TPC-H-like q1..q22: CPU-oracle vs TPU-path equality.
+
+Reference analogue: TpchLikeSparkSuite.scala — every query runs on the
+small checked-in dataset and the plugin result must match CPU Spark.
+Here each query is executed on a Session with tpu_enabled=False (host
+numpy engine, the oracle) and tpu_enabled=True (rewrite engine + device
+execs), and results are compared with the same sort/float tolerance
+semantics as asserts.py.
+"""
+import pytest
+
+from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+from spark_rapids_tpu.session import Session
+from spark_rapids_tpu.testing.asserts import assert_rows_equal
+
+SF = 0.0007
+SEED = 7
+
+
+def _run(qnum: int, tpu: bool):
+    sess = Session(tpu_enabled=tpu)
+    tables = tpch_datagen.dataframes(sess, sf=SF, seed=SEED)
+    df = tpch.QUERIES[qnum](tables)
+    return df.collect(), df.columns
+
+
+# queries whose output has no total order (ties in sort keys / no sort)
+_UNORDERED = {2, 5, 6, 10, 11, 13, 14, 16, 17, 18, 19, 21, 22}
+
+
+@pytest.mark.parametrize("qnum", sorted(tpch.QUERIES))
+def test_tpch_query_cpu_vs_tpu(qnum):
+    cpu_rows, cols = _run(qnum, tpu=False)
+    tpu_rows, _ = _run(qnum, tpu=True)
+    assert_rows_equal(cpu_rows, tpu_rows, ignore_order=True,
+                      approximate_float=1e-6)
+
+
+def test_tpch_nonempty_coverage():
+    """The generator must feed every query a non-trivial subset (guards
+    against the suite silently comparing empty results everywhere)."""
+    nonempty = 0
+    for qnum in sorted(tpch.QUERIES):
+        rows, _ = _run(qnum, tpu=False)
+        if rows:
+            nonempty += 1
+    assert nonempty >= 18, f"only {nonempty}/22 queries returned rows"
