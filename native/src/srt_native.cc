@@ -1,0 +1,343 @@
+// Native runtime components for the TPU SQL accelerator.
+//
+// The reference framework leans on three native libraries (SURVEY §2.9):
+// RMM (pooled device allocator), libcudf (kernels + JCudfSerialization),
+// and UCX (transport).  On TPU the kernels and transport are XLA's job,
+// but the *host runtime* around them is native here, as it is there:
+//
+//  * srt_arena_*  — first-fit address-space sub-allocator over one fixed
+//    host staging block (reference: AddressSpaceAllocator.scala, the
+//    backing allocator of RapidsHostMemoryStore).
+//  * srt_hpq_*    — hashed priority queue: O(log n) push/pop with O(1)
+//    membership/removal, the spill-victim queue (reference:
+//    HashedPriorityQueue.java).
+//  * srt_frame_*  — contiguous columnar batch serialization: one frame =
+//    header + per-column meta + validity + data, 64-byte aligned
+//    sections (reference: JCudfSerialization + the TableMeta flatbuffers
+//    in format/ShuffleCommon.fbs — buffer + per-column sub-buffer meta).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ==========================================================================
+// Arena: first-fit free-list allocator over [0, size)
+// ==========================================================================
+struct Arena {
+  std::mutex lock;
+  uint64_t size = 0;
+  uint8_t* base = nullptr;     // optional real backing memory
+  // offset -> length, sorted; adjacent blocks coalesced on free
+  std::map<uint64_t, uint64_t> free_blocks;
+  std::unordered_map<uint64_t, uint64_t> allocated;  // offset -> length
+  uint64_t allocated_bytes = 0;
+};
+
+void* srt_arena_create(uint64_t size, int with_backing) {
+  Arena* a = new Arena();
+  a->size = size;
+  a->free_blocks[0] = size;
+  if (with_backing) {
+    a->base = static_cast<uint8_t*>(malloc(size));
+    if (a->base == nullptr) {  // caller checks srt_arena_base for NULL
+      delete a;
+      return nullptr;
+    }
+  }
+  return a;
+}
+
+void srt_arena_destroy(void* h) {
+  Arena* a = static_cast<Arena*>(h);
+  if (a->base) free(a->base);
+  delete a;
+}
+
+// Returns offset, or -1 if no free block fits.
+int64_t srt_arena_alloc(void* h, uint64_t size) {
+  if (size == 0) return -1;
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->lock);
+  uint64_t want = (size + 63) & ~uint64_t(63);  // 64-byte aligned carve
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= want) {
+      uint64_t off = it->first;
+      uint64_t rest = it->second - want;
+      a->free_blocks.erase(it);
+      if (rest) a->free_blocks[off + want] = rest;
+      a->allocated[off] = want;
+      a->allocated_bytes += want;
+      return static_cast<int64_t>(off);
+    }
+  }
+  return -1;
+}
+
+int srt_arena_free(void* h, int64_t offset) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->lock);
+  auto it = a->allocated.find(static_cast<uint64_t>(offset));
+  if (it == a->allocated.end()) return 0;
+  uint64_t off = it->first, len = it->second;
+  a->allocated.erase(it);
+  a->allocated_bytes -= len;
+  auto next = a->free_blocks.lower_bound(off);
+  // coalesce with next block
+  if (next != a->free_blocks.end() && next->first == off + len) {
+    len += next->second;
+    next = a->free_blocks.erase(next);
+  }
+  // coalesce with previous block
+  if (next != a->free_blocks.begin()) {
+    auto prev = std::prev(next);
+    if (prev->first + prev->second == off) {
+      prev->second += len;
+      return 1;
+    }
+  }
+  a->free_blocks[off] = len;
+  return 1;
+}
+
+uint64_t srt_arena_allocated(void* h) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->lock);
+  return a->allocated_bytes;
+}
+
+uint64_t srt_arena_available(void* h) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->lock);
+  uint64_t total = 0;
+  for (auto& kv : a->free_blocks) total += kv.second;
+  return total;
+}
+
+uint64_t srt_arena_largest_free(void* h) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->lock);
+  uint64_t best = 0;
+  for (auto& kv : a->free_blocks) best = std::max(best, kv.second);
+  return best;
+}
+
+uint8_t* srt_arena_base(void* h) { return static_cast<Arena*>(h)->base; }
+
+// ==========================================================================
+// Hashed priority queue: min-heap + id->slot index
+// ==========================================================================
+struct Hpq {
+  std::mutex lock;
+  struct Node { int64_t id; double pri; uint64_t seq; };
+  std::vector<Node> heap;                     // 0-based binary min-heap
+  std::unordered_map<int64_t, size_t> slot;   // id -> heap index
+  uint64_t next_seq = 0;                      // FIFO tie-break
+};
+
+static bool hpq_less(const Hpq::Node& x, const Hpq::Node& y) {
+  if (x.pri != y.pri) return x.pri < y.pri;
+  return x.seq < y.seq;
+}
+
+static void hpq_swap(Hpq* q, size_t i, size_t j) {
+  std::swap(q->heap[i], q->heap[j]);
+  q->slot[q->heap[i].id] = i;
+  q->slot[q->heap[j].id] = j;
+}
+
+static void hpq_up(Hpq* q, size_t i) {
+  while (i > 0) {
+    size_t p = (i - 1) / 2;
+    if (hpq_less(q->heap[i], q->heap[p])) { hpq_swap(q, i, p); i = p; }
+    else break;
+  }
+}
+
+static void hpq_down(Hpq* q, size_t i) {
+  size_t n = q->heap.size();
+  for (;;) {
+    size_t l = 2 * i + 1, r = l + 1, m = i;
+    if (l < n && hpq_less(q->heap[l], q->heap[m])) m = l;
+    if (r < n && hpq_less(q->heap[r], q->heap[m])) m = r;
+    if (m == i) break;
+    hpq_swap(q, i, m);
+    i = m;
+  }
+}
+
+void* srt_hpq_create() { return new Hpq(); }
+void srt_hpq_destroy(void* h) { delete static_cast<Hpq*>(h); }
+
+// push or update-priority if present
+void srt_hpq_push(void* h, int64_t id, double pri) {
+  Hpq* q = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> g(q->lock);
+  auto it = q->slot.find(id);
+  if (it != q->slot.end()) {
+    size_t i = it->second;
+    q->heap[i].pri = pri;
+    q->heap[i].seq = q->next_seq++;
+    hpq_up(q, i);
+    hpq_down(q, i);
+    return;
+  }
+  q->heap.push_back({id, pri, q->next_seq++});
+  size_t i = q->heap.size() - 1;
+  q->slot[id] = i;
+  hpq_up(q, i);
+}
+
+static int64_t hpq_remove_at(Hpq* q, size_t i) {
+  int64_t id = q->heap[i].id;
+  size_t last = q->heap.size() - 1;
+  if (i != last) hpq_swap(q, i, last);
+  q->heap.pop_back();
+  q->slot.erase(id);
+  if (i < q->heap.size()) { hpq_up(q, i); hpq_down(q, i); }
+  return id;
+}
+
+int64_t srt_hpq_pop(void* h) {
+  Hpq* q = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> g(q->lock);
+  if (q->heap.empty()) return -1;
+  return hpq_remove_at(q, 0);
+}
+
+int64_t srt_hpq_peek(void* h) {
+  Hpq* q = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> g(q->lock);
+  return q->heap.empty() ? -1 : q->heap[0].id;
+}
+
+int srt_hpq_remove(void* h, int64_t id) {
+  Hpq* q = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> g(q->lock);
+  auto it = q->slot.find(id);
+  if (it == q->slot.end()) return 0;
+  hpq_remove_at(q, it->second);
+  return 1;
+}
+
+int srt_hpq_contains(void* h, int64_t id) {
+  Hpq* q = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> g(q->lock);
+  return q->slot.count(id) ? 1 : 0;
+}
+
+uint64_t srt_hpq_size(void* h) {
+  Hpq* q = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> g(q->lock);
+  return q->heap.size();
+}
+
+// ==========================================================================
+// Columnar frame serialization
+//
+// Frame layout (little-endian, all sections 64-byte aligned):
+//   [0]  magic  'SRTB' (u32)
+//   [4]  version (u32) = 1
+//   [8]  n_cols (u32)
+//   [12] n_rows (u64)
+//   [20] total_size (u64)
+//   [28] reserved to 64
+//   then per column: meta { dtype(i32), has_validity(i32),
+//                           data_len(u64), validity_len(u64) }
+//   then per column: validity bytes (aligned), data bytes (aligned)
+// ==========================================================================
+static const uint32_t kMagic = 0x42545253;  // 'SRTB'
+
+static uint64_t align64(uint64_t x) { return (x + 63) & ~uint64_t(63); }
+
+uint64_t srt_frame_size(uint32_t n_cols, const uint64_t* data_lens,
+                        const uint64_t* valid_lens) {
+  uint64_t sz = 64 + align64(uint64_t(n_cols) * 24);
+  for (uint32_t i = 0; i < n_cols; ++i) {
+    sz += align64(valid_lens[i]) + align64(data_lens[i]);
+  }
+  return sz;
+}
+
+// Writes the frame into dst (caller sized via srt_frame_size).
+// Returns bytes written.
+uint64_t srt_frame_write(uint8_t* dst, uint32_t n_cols, uint64_t n_rows,
+                         const uint8_t** datas, const uint64_t* data_lens,
+                         const uint8_t** valids, const uint64_t* valid_lens,
+                         const int32_t* dtypes) {
+  uint64_t total = srt_frame_size(n_cols, data_lens, valid_lens);
+  memset(dst, 0, 64);
+  memcpy(dst + 0, &kMagic, 4);
+  uint32_t ver = 1;
+  memcpy(dst + 4, &ver, 4);
+  memcpy(dst + 8, &n_cols, 4);
+  memcpy(dst + 12, &n_rows, 8);
+  memcpy(dst + 20, &total, 8);
+  uint64_t meta_off = 64;
+  uint64_t payload = 64 + align64(uint64_t(n_cols) * 24);
+  for (uint32_t i = 0; i < n_cols; ++i) {
+    uint8_t* m = dst + meta_off + uint64_t(i) * 24;
+    int32_t has_v = valid_lens[i] ? 1 : 0;
+    memcpy(m + 0, &dtypes[i], 4);
+    memcpy(m + 4, &has_v, 4);
+    memcpy(m + 8, &data_lens[i], 8);
+    memcpy(m + 16, &valid_lens[i], 8);
+  }
+  for (uint32_t i = 0; i < n_cols; ++i) {
+    if (valid_lens[i]) {
+      memcpy(dst + payload, valids[i], valid_lens[i]);
+      payload += align64(valid_lens[i]);
+    }
+    if (data_lens[i]) {
+      memcpy(dst + payload, datas[i], data_lens[i]);
+      payload += align64(data_lens[i]);
+    }
+  }
+  return total;
+}
+
+// Parse header: fills n_cols/n_rows/total; returns 1 if magic/version ok.
+int srt_frame_header(const uint8_t* src, uint32_t* n_cols, uint64_t* n_rows,
+                     uint64_t* total) {
+  uint32_t magic, ver;
+  memcpy(&magic, src + 0, 4);
+  memcpy(&ver, src + 4, 4);
+  if (magic != kMagic || ver != 1) return 0;
+  memcpy(n_cols, src + 8, 4);
+  memcpy(n_rows, src + 12, 8);
+  memcpy(total, src + 20, 8);
+  return 1;
+}
+
+// Per-column section pointers: writes per-col dtype, validity/data offsets
+// (relative to src) and lengths into the out arrays.
+void srt_frame_columns(const uint8_t* src, uint32_t n_cols,
+                       int32_t* dtypes, uint64_t* valid_offs,
+                       uint64_t* valid_lens, uint64_t* data_offs,
+                       uint64_t* data_lens) {
+  uint64_t payload = 64 + align64(uint64_t(n_cols) * 24);
+  for (uint32_t i = 0; i < n_cols; ++i) {
+    const uint8_t* m = src + 64 + uint64_t(i) * 24;
+    int32_t has_v;
+    memcpy(&dtypes[i], m + 0, 4);
+    memcpy(&has_v, m + 4, 4);
+    memcpy(&data_lens[i], m + 8, 8);
+    memcpy(&valid_lens[i], m + 16, 8);
+    if (has_v) {
+      valid_offs[i] = payload;
+      payload += align64(valid_lens[i]);
+    } else {
+      valid_offs[i] = 0;
+    }
+    data_offs[i] = payload;
+    payload += align64(data_lens[i]);
+  }
+}
+
+}  // extern "C"

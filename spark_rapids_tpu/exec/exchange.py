@@ -111,6 +111,15 @@ class TpuShuffleExchangeExec(TpuExec):
             return store[0]
 
         def pids_of(buf_id, b, rr_start):
+            from ..memory.spill import StorageTier
+
+            # evict cached pids whose batch left the device tier — they
+            # are unspillable HBM otherwise and would defeat the spill
+            for k in list(pid_cache):
+                if k != buf_id:
+                    bk = fw.catalog.get(k)
+                    if bk is None or bk.tier != StorageTier.DEVICE:
+                        pid_cache.pop(k, None)
             cached = pid_cache.get(buf_id)
             if cached is not None and cached[0] == id(b):
                 return cached[1]

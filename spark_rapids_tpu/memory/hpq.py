@@ -61,3 +61,61 @@ class HashedPriorityQueue:
         while self._heap and self._entries.get(
                 self._heap[0][2]) is not self._heap[0]:
             heapq.heappop(self._heap)
+
+
+class NativeHashedPriorityQueue:
+    """Same contract backed by the C++ heap (native/src/srt_native.cc,
+    srt_hpq_*) for integer keys — the spill queue's hot path."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._h = lib.srt_hpq_create()
+        self._pri: Dict[int, float] = {}  # mirror for priority_of
+
+    def __del__(self):  # pragma: no cover - interpreter teardown timing
+        try:
+            self._lib.srt_hpq_destroy(self._h)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __len__(self) -> int:
+        return int(self._lib.srt_hpq_size(self._h))
+
+    def __contains__(self, key) -> bool:
+        return bool(self._lib.srt_hpq_contains(self._h, int(key)))
+
+    def push(self, key, priority: float) -> None:
+        self._lib.srt_hpq_push(self._h, int(key), float(priority))
+        self._pri[int(key)] = float(priority)
+
+    def remove(self, key) -> bool:
+        self._pri.pop(int(key), None)
+        return bool(self._lib.srt_hpq_remove(self._h, int(key)))
+
+    def update_priority(self, key, priority: float) -> None:
+        self.push(key, priority)
+
+    def peek(self) -> Optional[int]:
+        k = int(self._lib.srt_hpq_peek(self._h))
+        return None if k < 0 else k
+
+    def pop(self) -> Optional[int]:
+        k = int(self._lib.srt_hpq_pop(self._h))
+        if k < 0:
+            return None
+        self._pri.pop(k, None)
+        return k
+
+    def priority_of(self, key) -> Optional[float]:
+        return self._pri.get(int(key))
+
+
+def make_spill_queue():
+    """Native-backed queue when the library is available, else Python
+    (keys are integer buffer ids either way)."""
+    from ..native import get_lib
+
+    lib = get_lib()
+    if lib is not None:
+        return NativeHashedPriorityQueue(lib)
+    return HashedPriorityQueue()

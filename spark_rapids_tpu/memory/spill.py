@@ -29,10 +29,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..data.column import (DeviceBatch, HostBatch, HostColumn,
-                           device_to_host, host_to_device)
-from .. import types as T
-from .hpq import HashedPriorityQueue
+from ..data.column import (DeviceBatch, HostBatch, device_to_host,
+                           host_to_device)
+from .hpq import make_spill_queue
 
 log = logging.getLogger(__name__)
 
@@ -72,52 +71,69 @@ class SpillableBuffer:
         self.size = size_bytes if size_bytes is not None \
             else batch.device_bytes()
         self._device: Optional[DeviceBatch] = batch
-        self._host: Optional[HostBatch] = None
+        # host tier payload: one contiguous serialized frame, either a
+        # carve of the staging arena (offset, nbytes) or a loose array
+        self._arena = None
+        self._arena_alloc: Optional[tuple] = None
+        self._frame: Optional[np.ndarray] = None
         self._disk_path: Optional[str] = None
         self._min_bucket = max(batch.padded_rows, 1)
         self.refcount = 0
         self.lock = threading.Lock()
 
     # ----- tier movement ---------------------------------------------------
-    def to_host(self) -> None:
+    def to_host(self, arena=None) -> None:
+        """Serialize into one contiguous frame on the host — inside the
+        staging arena when it has room, loose otherwise (reference:
+        RapidsHostMemoryStore carving its pinned allocation)."""
+        from ..native import serializer
+
         assert self.tier == StorageTier.DEVICE
-        self._host = device_to_host(self._device)
+        pf = serializer.PreparedFrame(device_to_host(self._device))
+        frame = None
+        if arena is not None:
+            off = arena.alloc(pf.size)
+            if off is not None:
+                pf.write_into(arena.view(off, pf.size))
+                self._arena = arena
+                self._arena_alloc = (off, pf.size)
+        if self._arena_alloc is None:
+            frame = np.zeros(pf.size, dtype=np.uint8)
+            pf.write_into(frame)
+        self._frame = frame
         self._device = None
         self.tier = StorageTier.HOST
 
+    def _host_frame(self) -> np.ndarray:
+        if self._arena_alloc is not None:
+            off, nbytes = self._arena_alloc
+            return self._arena.view(off, nbytes)
+        return self._frame
+
+    def _release_host(self) -> None:
+        if self._arena_alloc is not None:
+            self._arena.free(self._arena_alloc[0])
+            self._arena_alloc = None
+            self._arena = None
+        self._frame = None
+
     def to_disk(self, directory: str) -> None:
         assert self.tier == StorageTier.HOST
-        path = os.path.join(directory, f"buffer-{self.id}.npz")
-        arrays = {}
-        for i, c in enumerate(self._host.columns):
-            if c.dtype.id is T.TypeId.STRING:
-                arrays[f"d{i}"] = np.array(
-                    ["" if v is None else v for v in c.data], dtype=object)
-            else:
-                arrays[f"d{i}"] = c.data
-            arrays[f"v{i}"] = c.is_valid()
-        np.savez(path, **arrays)
+        path = os.path.join(directory, f"buffer-{self.id}.srtb")
+        self._host_frame().tofile(path)
+        self._release_host()
         self._disk_path = path
-        self._host = None
         self.tier = StorageTier.DISK
 
     def _load_host(self) -> HostBatch:
+        from ..native import serializer
+
         if self.tier == StorageTier.HOST:
-            return self._host
-        assert self.tier == StorageTier.DISK
-        with np.load(self._disk_path, allow_pickle=True) as z:
-            cols = []
-            for i, f in enumerate(self.schema):
-                data = z[f"d{i}"]
-                valid = z[f"v{i}"]
-                if f.dtype.id is T.TypeId.STRING:
-                    data = np.array([v if ok else None
-                                     for v, ok in zip(data, valid)],
-                                    dtype=object)
-                cols.append(HostColumn(
-                    f.dtype, data,
-                    None if valid.all() else valid))
-        return HostBatch(self.schema, cols)
+            frame = self._host_frame()
+        else:
+            assert self.tier == StorageTier.DISK
+            frame = np.fromfile(self._disk_path, dtype=np.uint8)
+        return serializer.deserialize(frame, self.schema)
 
     def get_device_batch(self) -> DeviceBatch:
         """Materialize at DEVICE tier (re-upload + promote if spilled)."""
@@ -126,7 +142,7 @@ class SpillableBuffer:
         hb = self._load_host()
         db = host_to_device(hb, min_bucket_rows=self._min_bucket)
         self._device = db
-        self._host = None
+        self._release_host()
         if self._disk_path and os.path.exists(self._disk_path):
             os.unlink(self._disk_path)
         self._disk_path = None
@@ -135,7 +151,7 @@ class SpillableBuffer:
 
     def free(self) -> None:
         self._device = None
-        self._host = None
+        self._release_host()
         if self._disk_path and os.path.exists(self._disk_path):
             os.unlink(self._disk_path)
         self._disk_path = None
@@ -194,8 +210,17 @@ class SpillFramework:
                  spill_dir: Optional[str] = None,
                  device_limit_bytes: Optional[int] = None):
         self.catalog = BufferCatalog()
-        self.device_queue = HashedPriorityQueue()
-        self.host_queue = HashedPriorityQueue()
+        self.device_queue = make_spill_queue()
+        self.host_queue = make_spill_queue()
+        # host staging arena for spill frames (reference: the pinned host
+        # pool behind RapidsHostMemoryStore); loose allocations when the
+        # native lib is unavailable or the arena is fragmented/full
+        try:
+            from ..native.arena import HostArena
+
+            self.host_arena = HostArena(host_limit_bytes)
+        except Exception:  # noqa: BLE001
+            self.host_arena = None
         self.device_bytes = 0
         self.host_bytes = 0
         self.host_limit = host_limit_bytes
@@ -300,7 +325,7 @@ class SpillFramework:
                     break  # everything pinned
                 buf = self.catalog.get(victim_id)
                 self.device_queue.remove(victim_id)
-                buf.to_host()
+                buf.to_host(self.host_arena)
                 self.device_bytes -= buf.size
                 self._track_device(-buf.size)
                 self.host_bytes += buf.size

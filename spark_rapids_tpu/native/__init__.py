@@ -1,0 +1,101 @@
+"""ctypes bindings for the native runtime library.
+
+The reference ships its runtime natively (RMM pool allocator, cudf's
+JCudfSerialization, HashedPriorityQueue on the hot spill path — SURVEY
+§2.5/§2.9); here the host-runtime equivalents live in
+``native/src/srt_native.cc`` and are loaded through ctypes (no pybind11
+in the image).  The library is compiled on first use via the checked-in
+Makefile and cached; every consumer has a pure-Python fallback, so the
+framework still works where no C++ toolchain exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libsrt_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-s", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # noqa: BLE001
+        log.warning("native build failed (%s); using Python fallbacks", e)
+        return False
+
+
+def _declare(lib) -> None:
+    c = ctypes
+    u64, i64, u32, i32 = c.c_uint64, c.c_int64, c.c_uint32, c.c_int32
+    p = c.c_void_p
+    u8p = c.POINTER(c.c_uint8)
+    sigs = {
+        "srt_arena_create": (p, [u64, i32]),
+        "srt_arena_destroy": (None, [p]),
+        "srt_arena_alloc": (i64, [p, u64]),
+        "srt_arena_free": (i32, [p, i64]),
+        "srt_arena_allocated": (u64, [p]),
+        "srt_arena_available": (u64, [p]),
+        "srt_arena_largest_free": (u64, [p]),
+        "srt_arena_base": (u8p, [p]),
+        "srt_hpq_create": (p, []),
+        "srt_hpq_destroy": (None, [p]),
+        "srt_hpq_push": (None, [p, i64, c.c_double]),
+        "srt_hpq_pop": (i64, [p]),
+        "srt_hpq_peek": (i64, [p]),
+        "srt_hpq_remove": (i32, [p, i64]),
+        "srt_hpq_contains": (i32, [p, i64]),
+        "srt_hpq_size": (u64, [p]),
+        "srt_frame_size": (u64, [u32, c.POINTER(u64), c.POINTER(u64)]),
+        "srt_frame_write": (u64, [u8p, u32, u64, c.POINTER(u8p),
+                                  c.POINTER(u64), c.POINTER(u8p),
+                                  c.POINTER(u64), c.POINTER(i32)]),
+        "srt_frame_header": (i32, [u8p, c.POINTER(u32), c.POINTER(u64),
+                                   c.POINTER(u64)]),
+        "srt_frame_columns": (None, [u8p, u32, c.POINTER(i32),
+                                     c.POINTER(u64), c.POINTER(u64),
+                                     c.POINTER(u64), c.POINTER(u64)]),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            _declare(lib)
+            _lib = lib
+        except OSError as e:
+            log.warning("native load failed (%s); using Python fallbacks", e)
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,66 @@
+"""Host staging arena over the native first-fit allocator.
+
+Reference analogue: RapidsHostMemoryStore — one big host allocation
+carved by AddressSpaceAllocator.scala's first-fit range allocator; spill
+payloads live inside it rather than as loose heap objects.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from . import get_lib
+
+
+class HostArena:
+    """Fixed-size backed host arena: alloc/free byte ranges, expose each
+    range as a numpy view for zero-copy frame writes."""
+
+    def __init__(self, size_bytes: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.size = int(size_bytes)
+        self._h = lib.srt_arena_create(self.size, 1)
+        if not self._h:
+            raise MemoryError(
+                f"cannot back a {self.size}-byte host arena")
+        base = lib.srt_arena_base(self._h)
+        if not base:
+            lib.srt_arena_destroy(self._h)
+            self._h = None
+            raise MemoryError("host arena backing allocation failed")
+        self._mem = np.ctypeslib.as_array(base, shape=(self.size,))
+
+    def __del__(self):  # pragma: no cover - interpreter teardown timing
+        try:
+            if self._h:
+                self._lib.srt_arena_destroy(self._h)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def alloc(self, nbytes: int) -> Optional[int]:
+        """Returns the offset of a 64-byte-aligned carve, or None."""
+        off = int(self._lib.srt_arena_alloc(self._h, int(nbytes)))
+        return None if off < 0 else off
+
+    def free(self, offset: int) -> bool:
+        return bool(self._lib.srt_arena_free(self._h, int(offset)))
+
+    def view(self, offset: int, nbytes: int) -> np.ndarray:
+        return self._mem[offset:offset + nbytes]
+
+    @property
+    def allocated_bytes(self) -> int:
+        return int(self._lib.srt_arena_allocated(self._h))
+
+    @property
+    def available_bytes(self) -> int:
+        return int(self._lib.srt_arena_available(self._h))
+
+    @property
+    def largest_free_block(self) -> int:
+        return int(self._lib.srt_arena_largest_free(self._h))

@@ -1,0 +1,201 @@
+"""Contiguous columnar frame serialization.
+
+Reference analogue: JCudfSerialization (the serialized-table format that
+rides Spark's shuffle streams, GpuColumnarBatchSerializer.scala:36-246)
+plus the TableMeta buffer/sub-buffer metadata (format/ShuffleCommon.fbs).
+One ``HostBatch`` becomes ONE contiguous byte frame: header, per-column
+meta, then 64-byte-aligned validity and data sections — the unit of host
+spill storage and disk spill files.
+
+Framing runs through the native library (srt_frame_*) when available;
+the identical layout is produced/parsed by the numpy fallback, so frames
+are interchangeable between the two writers.
+
+String columns (object ndarrays) pack as:
+    [int64 total_utf8_bytes][int64 offsets (n+1)][utf8 payload]
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .. import types as T
+from ..data.column import HostBatch, HostColumn
+from . import get_lib
+
+_ALIGN = 64
+_HEADER = 64
+_COLMETA = 24
+
+# TypeId enum values are sql-name strings; frames need stable ints
+_TYPE_CODE = {tid: i for i, tid in enumerate(T.TypeId)}
+
+
+def _align(x: int) -> int:
+    return (x + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _encode_strings(col: HostColumn) -> np.ndarray:
+    n = len(col.data)
+    valid = col.validity
+    payload = []
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i in range(n):
+        if valid is not None and not valid[i]:
+            b = b""
+        else:
+            v = col.data[i]
+            b = v.encode("utf-8") if isinstance(v, str) else (v or b"")
+        payload.append(b)
+        offsets[i + 1] = offsets[i] + len(b)
+    blob = b"".join(payload)
+    out = np.empty(8 + offsets.nbytes + len(blob), dtype=np.uint8)
+    out[:8] = np.frombuffer(
+        np.int64(len(blob)).tobytes(), dtype=np.uint8)
+    out[8:8 + offsets.nbytes] = np.frombuffer(offsets.tobytes(),
+                                              dtype=np.uint8)
+    if blob:
+        out[8 + offsets.nbytes:] = np.frombuffer(blob, dtype=np.uint8)
+    return out
+
+
+def _decode_strings(raw: np.ndarray, n_rows: int,
+                    valid: Optional[np.ndarray]) -> np.ndarray:
+    offsets = np.frombuffer(raw[8:8 + (n_rows + 1) * 8].tobytes(),
+                            dtype=np.int64)
+    payload = raw[8 + (n_rows + 1) * 8:].tobytes()
+    out = np.empty(n_rows, dtype=object)
+    for i in range(n_rows):
+        if valid is not None and not valid[i]:
+            out[i] = None
+        else:
+            out[i] = payload[offsets[i]:offsets[i + 1]].decode("utf-8")
+    return out
+
+
+def _column_parts(col: HostColumn):
+    """(dtype_id, data_u8, valid_u8_or_None) for one column."""
+    if col.dtype.id is T.TypeId.STRING:
+        data = _encode_strings(col)
+    else:
+        data = np.ascontiguousarray(col.data).view(np.uint8).reshape(-1)
+    valid = None
+    if col.validity is not None:
+        valid = np.ascontiguousarray(
+            col.validity.astype(np.uint8)).reshape(-1)
+    return _TYPE_CODE[col.dtype.id], data, valid
+
+
+class PreparedFrame:
+    """Encoded columns + computed size, so callers can allocate the
+    destination (e.g. an arena carve) and write once — no intermediate
+    full-frame copy on the spill path."""
+
+    def __init__(self, batch: HostBatch):
+        self.parts = [_column_parts(c) for c in batch.columns]
+        self.n_rows = batch.num_rows
+        self.size = _HEADER + _align(len(self.parts) * _COLMETA) + sum(
+            _align(0 if v is None else v.nbytes) + _align(d.nbytes)
+            for _, d, v in self.parts)
+
+    def write_into(self, out: np.ndarray) -> None:
+        assert out.nbytes >= self.size
+        _write(out, self.parts, self.n_rows, self.size)
+
+
+def frame_size(batch: HostBatch) -> int:
+    return PreparedFrame(batch).size
+
+
+def serialize(batch: HostBatch) -> np.ndarray:
+    """HostBatch -> one contiguous uint8 frame."""
+    pf = PreparedFrame(batch)
+    out = np.zeros(pf.size, dtype=np.uint8)
+    pf.write_into(out)
+    return out
+
+
+def _write(out: np.ndarray, parts, n_rows: int, total: int) -> None:
+    n_cols = len(parts)
+    lib = get_lib()
+    if lib is not None:
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        datas = (u8p * n_cols)(*[d.ctypes.data_as(u8p) for _, d, _ in parts])
+        dlens = (ctypes.c_uint64 * n_cols)(*[d.nbytes for _, d, _ in parts])
+        # keep a zero-length placeholder pointer for validity-less columns
+        zeros = np.zeros(1, dtype=np.uint8)
+        valids = (u8p * n_cols)(*[
+            (v if v is not None else zeros).ctypes.data_as(u8p)
+            for _, _, v in parts])
+        vlens = (ctypes.c_uint64 * n_cols)(*[
+            0 if v is None else v.nbytes for _, _, v in parts])
+        dts = (ctypes.c_int32 * n_cols)(*[t for t, _, _ in parts])
+        n = lib.srt_frame_write(out.ctypes.data_as(u8p), n_cols, n_rows,
+                                datas, dlens, valids, vlens, dts)
+        assert n == total, (n, total)
+        return
+    # ----- numpy fallback: identical layout ------------------------------
+    out[0:4] = np.frombuffer(np.uint32(0x42545253).tobytes(), np.uint8)
+    out[4:8] = np.frombuffer(np.uint32(1).tobytes(), np.uint8)
+    out[8:12] = np.frombuffer(np.uint32(n_cols).tobytes(), np.uint8)
+    out[12:20] = np.frombuffer(np.uint64(n_rows).tobytes(), np.uint8)
+    out[20:28] = np.frombuffer(np.uint64(total).tobytes(), np.uint8)
+    off = _HEADER
+    for i, (t, d, v) in enumerate(parts):
+        m = _HEADER + i * _COLMETA
+        out[m:m + 4] = np.frombuffer(np.int32(t).tobytes(), np.uint8)
+        out[m + 4:m + 8] = np.frombuffer(
+            np.int32(0 if v is None else 1).tobytes(), np.uint8)
+        out[m + 8:m + 16] = np.frombuffer(
+            np.uint64(d.nbytes).tobytes(), np.uint8)
+        out[m + 16:m + 24] = np.frombuffer(
+            np.uint64(0 if v is None else v.nbytes).tobytes(), np.uint8)
+    off = _HEADER + _align(n_cols * _COLMETA)
+    for t, d, v in parts:
+        if v is not None:
+            out[off:off + v.nbytes] = v
+            off += _align(v.nbytes)
+        if d.nbytes:
+            out[off:off + d.nbytes] = d
+        off += _align(d.nbytes)
+
+
+def deserialize(frame: np.ndarray, schema: T.Schema) -> HostBatch:
+    """One contiguous uint8 frame -> HostBatch (schema supplies dtypes;
+    the frame's embedded dtype ids are a cross-check)."""
+    frame = np.ascontiguousarray(frame, dtype=np.uint8)
+    magic = int(np.frombuffer(frame[0:4].tobytes(), np.uint32)[0])
+    if magic != 0x42545253:
+        raise ValueError("bad frame magic")
+    n_cols = int(np.frombuffer(frame[8:12].tobytes(), np.uint32)[0])
+    n_rows = int(np.frombuffer(frame[12:20].tobytes(), np.uint64)[0])
+    if n_cols != len(schema):
+        raise ValueError(f"frame has {n_cols} cols, schema {len(schema)}")
+    cols = []
+    off = _HEADER + _align(n_cols * _COLMETA)
+    for i, f in enumerate(schema):
+        m = _HEADER + i * _COLMETA
+        dt_id = int(np.frombuffer(frame[m:m + 4].tobytes(), np.int32)[0])
+        has_v = int(np.frombuffer(frame[m + 4:m + 8].tobytes(),
+                                  np.int32)[0])
+        dlen = int(np.frombuffer(frame[m + 8:m + 16].tobytes(),
+                                 np.uint64)[0])
+        vlen = int(np.frombuffer(frame[m + 16:m + 24].tobytes(),
+                                 np.uint64)[0])
+        if dt_id != _TYPE_CODE[f.dtype.id]:
+            raise ValueError(
+                f"column {i}: frame dtype {dt_id} != schema {f.dtype}")
+        valid = None
+        if has_v:
+            valid = frame[off:off + vlen].astype(np.bool_)
+            off += _align(vlen)
+        raw = frame[off:off + dlen]
+        off += _align(dlen)
+        if f.dtype.id is T.TypeId.STRING:
+            data = _decode_strings(raw, n_rows, valid)
+        else:
+            data = np.frombuffer(raw.tobytes(), dtype=f.dtype.np_dtype)
+        cols.append(HostColumn(f.dtype, data, valid))
+    return HostBatch(schema, cols)

@@ -1,0 +1,210 @@
+"""Native runtime components: arena, hashed priority queue, frame
+serializer (native/src/srt_native.cc via ctypes), and their integration
+with the spill tiers.
+
+Reference analogues: AddressSpaceAllocatorSuite, TestHashedPriorityQueue
+(Java), MetaUtilsSuite (serialized-table meta round trip).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.data.column import HostBatch, HostColumn
+from spark_rapids_tpu.memory.hpq import (HashedPriorityQueue,
+                                         NativeHashedPriorityQueue)
+from spark_rapids_tpu.native import available, get_lib
+from spark_rapids_tpu.native import serializer as S
+
+needs_native = pytest.mark.skipif(not available(),
+                                  reason="native lib unavailable")
+
+
+def _batch(n=257, seed=3):
+    rng = np.random.default_rng(seed)
+    schema = T.Schema([
+        T.Field("i64", T.INT64), T.Field("i32", T.INT32),
+        T.Field("f64", T.FLOAT64), T.Field("f32", T.FLOAT32),
+        T.Field("b", T.BOOL), T.Field("d", T.DATE32),
+        T.Field("s", T.STRING),
+    ])
+    valid = rng.random(n) > 0.2
+    sv = np.array([None if i % 5 == 0 else f"v-{i}-é"
+                   for i in range(n)], dtype=object)
+    return HostBatch(schema, [
+        HostColumn(T.INT64, rng.integers(-10**12, 10**12, n), valid.copy()),
+        HostColumn(T.INT32, rng.integers(-10**6, 10**6, n)
+                   .astype(np.int32)),
+        HostColumn(T.FLOAT64, rng.random(n)),
+        HostColumn(T.FLOAT32, rng.random(n).astype(np.float32),
+                   valid.copy()),
+        HostColumn(T.BOOL, rng.random(n) > 0.5),
+        HostColumn(T.DATE32, rng.integers(-10000, 30000, n)
+                   .astype(np.int32)),
+        HostColumn(T.STRING, sv,
+                   np.array([v is not None for v in sv])),
+    ])
+
+
+def _assert_batches_equal(a: HostBatch, b: HostBatch):
+    assert a.num_rows == b.num_rows
+    for c1, c2 in zip(a.columns, b.columns):
+        m = c1.is_valid()
+        assert np.array_equal(m, c2.is_valid())
+        if c1.dtype.id is T.TypeId.STRING:
+            assert all(x == y for x, y, ok
+                       in zip(c1.data, c2.data, m) if ok)
+        else:
+            assert np.array_equal(np.asarray(c1.data)[m],
+                                  np.asarray(c2.data)[m])
+
+
+# ===========================================================================
+# arena
+# ===========================================================================
+@needs_native
+def test_arena_alloc_free_coalesce():
+    from spark_rapids_tpu.native.arena import HostArena
+
+    a = HostArena(1 << 16)
+    offs = [a.alloc(1000) for _ in range(10)]
+    assert all(o is not None for o in offs)
+    assert a.allocated_bytes == 10 * 1024  # 64-byte aligned carves
+    # free every other block; holes are too small for a big alloc
+    for o in offs[::2]:
+        assert a.free(o)
+    assert a.alloc(6 * 1024) is not None  # fits in the tail
+    # free the rest: coalescing must reassemble one big block
+    for o in offs[1::2]:
+        assert a.free(o)
+    assert a.largest_free_block >= 9 * 1024
+
+
+@needs_native
+def test_arena_exhaustion_and_first_fit():
+    from spark_rapids_tpu.native.arena import HostArena
+
+    a = HostArena(4096)
+    o1 = a.alloc(2048)
+    o2 = a.alloc(2048)
+    assert o1 is not None and o2 is not None
+    assert a.alloc(64) is None  # full
+    a.free(o1)
+    assert a.alloc(100) == o1  # first fit reuses the first hole
+    assert not a.free(12345)  # unknown offset is a no-op
+
+
+@needs_native
+def test_arena_view_is_backed():
+    from spark_rapids_tpu.native.arena import HostArena
+
+    a = HostArena(8192)
+    off = a.alloc(256)
+    a.view(off, 256)[:] = np.arange(256, dtype=np.uint8)
+    assert np.array_equal(a.view(off, 256),
+                          np.arange(256, dtype=np.uint8))
+
+
+# ===========================================================================
+# hashed priority queue
+# ===========================================================================
+@needs_native
+def test_native_hpq_matches_python_reference():
+    nq = NativeHashedPriorityQueue(get_lib())
+    pq = HashedPriorityQueue()
+    rng = random.Random(17)
+    for _ in range(5000):
+        op = rng.random()
+        if op < 0.55:
+            k, p = rng.randrange(400), rng.choice(
+                [rng.random(), float("inf"), 0.0])
+            nq.push(k, p)
+            pq.push(k, p)
+        elif op < 0.75:
+            k = rng.randrange(400)
+            assert nq.remove(k) == pq.remove(k)
+            assert (k in nq) == (k in pq)
+        elif op < 0.9:
+            assert nq.pop() == pq.pop()
+        else:
+            assert nq.peek() == pq.peek()
+        assert len(nq) == len(pq)
+    while True:
+        a, b = nq.pop(), pq.pop()
+        assert a == b
+        if a is None:
+            break
+
+
+# ===========================================================================
+# frame serializer
+# ===========================================================================
+def test_frame_round_trip_all_types():
+    hb = _batch()
+    frame = S.serialize(hb)
+    _assert_batches_equal(hb, S.deserialize(frame, hb.schema))
+
+
+def test_frame_empty_batch():
+    schema = T.Schema([T.Field("x", T.INT64), T.Field("s", T.STRING)])
+    hb = HostBatch(schema, [
+        HostColumn(T.INT64, np.array([], dtype=np.int64)),
+        HostColumn(T.STRING, np.array([], dtype=object)),
+    ])
+    _assert_batches_equal(hb, S.deserialize(S.serialize(hb), schema))
+
+
+@needs_native
+def test_frame_writers_byte_identical():
+    """Native and numpy writers must produce interchangeable frames."""
+    import spark_rapids_tpu.native as N
+
+    hb = _batch(n=129, seed=11)
+    native_frame = S.serialize(hb)
+    saved, N._lib, N._load_failed = N._lib, None, True
+    try:
+        py_frame = S.serialize(hb)
+    finally:
+        N._lib, N._load_failed = saved, False
+    assert np.array_equal(native_frame, py_frame)
+
+
+def test_frame_rejects_garbage():
+    with pytest.raises(ValueError):
+        S.deserialize(np.zeros(128, dtype=np.uint8),
+                      T.Schema([T.Field("x", T.INT64)]))
+
+
+# ===========================================================================
+# spill integration: frames through host arena and disk
+# ===========================================================================
+def test_spill_tiers_use_frames(tmp_path):
+    from spark_rapids_tpu.data.column import host_to_device
+    from spark_rapids_tpu.memory.spill import (SpillFramework, StorageTier)
+
+    fw = SpillFramework(host_limit_bytes=1 << 22,
+                        spill_dir=str(tmp_path))
+    hb = _batch(n=200, seed=5)
+    db = host_to_device(hb)
+    bid = fw.add_batch(db)
+    buf = fw.catalog.get(bid)
+
+    fw.spill_device_to_target(0)
+    assert buf.tier == StorageTier.HOST
+    if fw.host_arena is not None:
+        assert buf._arena_alloc is not None  # frame carved from the arena
+
+    buf.to_disk(str(tmp_path))
+    assert buf.tier == StorageTier.DISK
+    files = list(tmp_path.glob("buffer-*.srtb"))
+    assert len(files) == 1
+
+    out = fw.acquire_batch(bid)
+    assert buf.tier == StorageTier.DEVICE
+    _assert_batches_equal(hb, __import__(
+        "spark_rapids_tpu.data.column", fromlist=["device_to_host"])
+        .device_to_host(out))
+    fw.release_batch(bid)
+    fw.remove_batch(bid)
+    assert not list(tmp_path.glob("buffer-*.srtb"))
