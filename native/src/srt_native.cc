@@ -273,6 +273,10 @@ uint64_t srt_frame_write(uint8_t* dst, uint32_t n_cols, uint64_t n_rows,
                          const int32_t* dtypes) {
   uint64_t total = srt_frame_size(n_cols, data_lens, valid_lens);
   memset(dst, 0, 64);
+  // zero the meta-table padding so alignment gaps never leak stale bytes
+  // (frames are written into reused arena carves and spilled verbatim)
+  memset(dst + 64 + uint64_t(n_cols) * 24, 0,
+         align64(uint64_t(n_cols) * 24) - uint64_t(n_cols) * 24);
   memcpy(dst + 0, &kMagic, 4);
   uint32_t ver = 1;
   memcpy(dst + 4, &ver, 4);
@@ -292,12 +296,16 @@ uint64_t srt_frame_write(uint8_t* dst, uint32_t n_cols, uint64_t n_rows,
   for (uint32_t i = 0; i < n_cols; ++i) {
     if (valid_lens[i]) {
       memcpy(dst + payload, valids[i], valid_lens[i]);
+      memset(dst + payload + valid_lens[i], 0,
+             align64(valid_lens[i]) - valid_lens[i]);
       payload += align64(valid_lens[i]);
     }
     if (data_lens[i]) {
       memcpy(dst + payload, datas[i], data_lens[i]);
-      payload += align64(data_lens[i]);
     }
+    memset(dst + payload + data_lens[i], 0,
+           align64(data_lens[i]) - data_lens[i]);
+    payload += align64(data_lens[i]);
   }
   return total;
 }

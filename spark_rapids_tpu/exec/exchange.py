@@ -110,16 +110,17 @@ class TpuShuffleExchangeExec(TpuExec):
                 store.append(items)
             return store[0]
 
-        def pids_of(buf_id, b, rr_start):
-            from ..memory.spill import StorageTier
-
+        def evict_offdevice_pids():
             # evict cached pids whose batch left the device tier — they
             # are unspillable HBM otherwise and would defeat the spill
+            from ..memory.spill import StorageTier
+
             for k in list(pid_cache):
-                if k != buf_id:
-                    bk = fw.catalog.get(k)
-                    if bk is None or bk.tier != StorageTier.DEVICE:
-                        pid_cache.pop(k, None)
+                bk = fw.catalog.get(k)
+                if bk is None or bk.tier != StorageTier.DEVICE:
+                    pid_cache.pop(k, None)
+
+        def pids_of(buf_id, b, rr_start):
             cached = pid_cache.get(buf_id)
             if cached is not None and cached[0] == id(b):
                 return cached[1]
@@ -131,6 +132,7 @@ class TpuShuffleExchangeExec(TpuExec):
             def it():
                 import jax.numpy as jnp
 
+                evict_offdevice_pids()  # once per reader pass
                 for buf_id, rr_start in materialized():
                     b = fw.acquire_batch(buf_id)
                     try:

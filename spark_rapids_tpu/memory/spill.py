@@ -10,9 +10,11 @@ DeviceMemoryEventHandler (alloc failure → spill until the allocation
 can succeed).
 
 TPU mapping: a DEVICE buffer is a DeviceBatch (jax arrays in HBM);
-spilling device→host is a device_to_host copy (numpy), host→disk is an
-.npz file under a spill directory.  Re-acquiring a spilled buffer at
-DEVICE re-uploads and promotes it back.  There is no RMM callback to
+spilling device→host serializes the batch into one contiguous columnar
+frame (native/src/srt_native.cc layout) carved from the host staging
+arena, and host→disk writes that frame verbatim as a ``.srtb`` file
+under a spill directory.  Re-acquiring a spilled buffer at DEVICE
+re-uploads and promotes it back.  There is no RMM callback to
 intercept — the DeviceManager's logical-arena accounting calls
 ``on_alloc_failure`` when tracked usage crosses the arena size, the
 same contract the reference's event handler has.

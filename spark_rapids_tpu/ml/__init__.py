@@ -1,0 +1,34 @@
+"""ML interop (reference: ColumnarRdd / InternalColumnarRddConverter,
+docs/ml-integration.md — zero-copy DataFrame -> device-table export for
+XGBoost-style consumers).
+
+    from spark_rapids_tpu import ml
+    batches = ml.columnar_batches(df)       # List[DeviceBatch] in HBM
+    X = ml.feature_matrix(df)               # 2-D float32 jax array
+    df2 = ml.from_device_batches(sess, bs)  # reverse path
+
+Requires ``spark.rapids.tpu.sql.exportColumnarRdd=true`` on the session,
+mirroring the reference's gate (RapidsConf.scala:312).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..data.column import DeviceBatch
+from .columnar_export import from_device_batches, to_feature_matrix
+
+
+def columnar_batches(df) -> List[DeviceBatch]:
+    """Execute ``df`` and return its result as device-resident batches
+    (jax arrays in HBM) without a host round trip."""
+    return df.session.execute_columnar(df.plan)
+
+
+def feature_matrix(df, columns: Optional[List[str]] = None):
+    """Execute ``df`` and stack (numeric) columns into one 2-D float32
+    jax array [rows, features]."""
+    return to_feature_matrix(columnar_batches(df), columns)
+
+
+__all__ = ["columnar_batches", "feature_matrix", "from_device_batches",
+           "to_feature_matrix"]

@@ -1,0 +1,84 @@
+"""Zero-copy device export for ML interop.
+
+Reference analogue: ColumnarRdd.scala:41-49 + InternalColumnarRddConverter
+(DataFrame -> RDD[cudf.Table] without a device->host round trip, for
+XGBoost-style consumers; gated by the exportColumnarRdd conf,
+RapidsConf.scala:312).  Here the executed plan's final device stage is
+peeled off its DeviceToHost transition and the resident ``DeviceBatch``es
+(jax arrays in HBM) are handed to the caller directly.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..data.column import DeviceBatch, HostBatch
+from ..exec.base import DevicePartitionedData
+from ..exec.transitions import DeviceToHostExec
+from ..plan import logical as L
+from ..plan.physical import ExecContext
+
+
+def export_device_batches(session, plan: L.LogicalPlan) -> List[DeviceBatch]:
+    """Execute ``plan`` and return the final columnar stage's device
+    batches without downloading them (the reference peels
+    GpuColumnarToRowExec off the executed plan the same way)."""
+    phys = session.physical_plan(plan)
+    if session.capture_plans:
+        session._executed_plans.append(phys)
+    # peel device->host transitions at the root so the final stage stays
+    # on the device (reference: detectAndTagFinalColumnarOutput,
+    # GpuTransitionOverrides.scala:256-261)
+    while isinstance(phys, DeviceToHostExec):
+        phys = phys.children[0]
+    ctx = ExecContext(session.conf, session)
+    data = phys.execute_columnar(ctx) if hasattr(phys, "execute_columnar") \
+        else phys.execute(ctx)
+    out: List[DeviceBatch] = []
+    for pid in range(data.n_partitions):
+        for b in data.iterator(pid):
+            if isinstance(b, HostBatch):  # plan fell back to the host
+                from ..data.column import host_to_device
+
+                b = host_to_device(b)
+            out.append(b)
+    return out
+
+
+def to_feature_matrix(batches: List[DeviceBatch], columns=None):
+    """Stack numeric columns of the exported batches into one 2-D
+    float32 jax array [rows, features] — the XGBoost/NN hand-off shape.
+    Padding rows and rows with a NULL in any selected column are dropped
+    (device storage zero-fills invalid lanes; exporting them as real 0.0
+    features would silently fabricate data)."""
+    import jax.numpy as jnp
+
+    if not batches:
+        raise ValueError("no batches to export")
+    schema = batches[0].schema
+    names = columns or [f.name for f in schema
+                        if f.dtype.is_numeric or f.dtype.is_bool]
+    mats = []
+    for b in batches:
+        n = int(b.num_rows)
+        cols, valid = [], None
+        for name in names:
+            c = b.column(name)
+            cols.append(c.data.astype(jnp.float32))
+            v = c.validity[:n]
+            valid = v if valid is None else (valid & v)
+        m = jnp.stack(cols, axis=1)[:n]
+        if valid is not None and not bool(valid.all()):
+            m = m[valid]
+        mats.append(m)
+    return jnp.concatenate(mats, axis=0)
+
+
+def from_device_batches(session, batches: List[DeviceBatch]):
+    """Reverse path: device batches -> DataFrame (reference:
+    GpuExternalRowToColumnConverter, the RDD[Row] -> batches direction)."""
+    from ..data.column import device_to_host
+
+    if not batches:
+        raise ValueError("no batches")
+    hbs = [device_to_host(b) for b in batches]
+    return session.create_dataframe(HostBatch.concat(hbs))

@@ -84,7 +84,10 @@ def get_lib():
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_SO_PATH) and not _build():
+        # always invoke make: the Makefile's source dependency makes it a
+        # no-op when fresh and rebuilds when srt_native.cc changed (a
+        # stale .so would silently diverge from the numpy fallback)
+        if not _build() and not os.path.exists(_SO_PATH):
             _load_failed = True
             return None
         try:

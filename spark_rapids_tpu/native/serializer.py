@@ -142,7 +142,10 @@ def _write(out: np.ndarray, parts, n_rows: int, total: int) -> None:
     out[8:12] = np.frombuffer(np.uint32(n_cols).tobytes(), np.uint8)
     out[12:20] = np.frombuffer(np.uint64(n_rows).tobytes(), np.uint8)
     out[20:28] = np.frombuffer(np.uint64(total).tobytes(), np.uint8)
-    off = _HEADER
+    # zero padding gaps explicitly: the destination may be a reused arena
+    # carve, and frames are spilled to disk verbatim
+    out[28:_HEADER] = 0
+    out[_HEADER + n_cols * _COLMETA:_HEADER + _align(n_cols * _COLMETA)] = 0
     for i, (t, d, v) in enumerate(parts):
         m = _HEADER + i * _COLMETA
         out[m:m + 4] = np.frombuffer(np.int32(t).tobytes(), np.uint8)
@@ -156,9 +159,11 @@ def _write(out: np.ndarray, parts, n_rows: int, total: int) -> None:
     for t, d, v in parts:
         if v is not None:
             out[off:off + v.nbytes] = v
+            out[off + v.nbytes:off + _align(v.nbytes)] = 0
             off += _align(v.nbytes)
         if d.nbytes:
             out[off:off + d.nbytes] = d
+        out[off + d.nbytes:off + _align(d.nbytes)] = 0
         off += _align(d.nbytes)
 
 

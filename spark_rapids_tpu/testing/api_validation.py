@@ -1,0 +1,126 @@
+"""API validation: inventory drift report.
+
+Reference analogue: the ``api_validation`` module
+(ApiValidation.scala) — reflection-compares each Spark exec's
+constructor signature against its Gpu twin and reports drift.  Here the
+host engine and the device engine live in one codebase, so validation
+checks three parity surfaces instead:
+
+  1. every host physical exec has a registered TPU conversion rule
+     (or is a known host-only node),
+  2. every registered expression class implements BOTH backends
+     (eval_cpu and eval_tpu overridden — the dual-engine contract of
+     ops/expression.py),
+  3. every rule's auto-derived enable key exists in the config registry.
+
+Run ``python -m spark_rapids_tpu.testing.api_validation`` for the report;
+the test suite asserts the report is clean.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import List
+
+from ..config import lookup
+from ..ops import aggregates as agg
+from ..ops.expression import Expression
+from ..plan import physical as P
+
+
+# host-only nodes by design: transitions, scans (converted via ScanRule
+# analogues in the planner), and the host-side write/coalesce machinery
+HOST_ONLY_EXECS = {
+    "PhysicalPlan", "LocalScanExec", "HostToDeviceExec", "DeviceToHostExec",
+    "DataWritingCommandExec", "CoalescePartitionsExec",
+    # explode generates data-dependent row counts per input row; runs on
+    # the host engine (device impl is an open item, like the reference's
+    # narrow GpuGenerateExec support for literal arrays only)
+    "GenerateExec",
+}
+
+# expressions whose device eval intentionally does not exist; their rules
+# tag the subtree back to the host engine (reference: the regex-escape
+# bail-outs at GpuOverrides.scala:326-371 and the string/TZ gates)
+INTENTIONAL_HOST_EXPRS = {
+    "UnresolvedAttribute",    # always bound before evaluation
+    "Like", "RegExpReplace",  # regex-class: host fallback by design
+    "StringReplace", "SubstringIndex",  # variable-width rewrite on host
+    "UnixTimestampParse", "FromUnixTime",  # strftime parse/format on host
+    "InputFileName", "InputFileBlockStart",
+    "InputFileBlockLength",   # scan-context intrinsics, host metadata
+}
+
+
+def _all_host_execs() -> List[type]:
+    out = []
+    for name in dir(P):
+        obj = getattr(P, name)
+        if (inspect.isclass(obj) and issubclass(obj, P.PhysicalPlan)
+                and obj.__module__ == P.__name__):
+            out.append(obj)
+    return out
+
+
+def _overridden(cls: type, method: str, base: type) -> bool:
+    return getattr(cls, method, None) is not getattr(base, method)
+
+
+def validate() -> List[str]:
+    """Returns a list of drift findings (empty = clean)."""
+    from ..plan.overrides import EXEC_RULES, EXPR_RULES, _ensure_registry
+
+    _ensure_registry()
+    findings = []
+
+    # 1. exec coverage
+    for cls in _all_host_execs():
+        if cls.__name__ in HOST_ONLY_EXECS:
+            continue
+        if cls not in EXEC_RULES:
+            findings.append(
+                f"exec {cls.__name__}: no TPU conversion rule registered")
+
+    # 2. expression dual-backend contract
+    for cls in EXPR_RULES:
+        if issubclass(cls, agg.AggregateExpression):
+            continue  # interpreted by the aggregate exec, not evaluated
+        if cls.__name__ in INTENTIONAL_HOST_EXPRS:
+            continue
+        for method in ("eval_cpu", "eval_tpu"):
+            impl = False
+            for k in cls.__mro__:
+                if k is Expression:
+                    break
+                if method in vars(k) or f"{method}_with_nulls" in vars(k) \
+                        or "eval_with_nulls" in vars(k) \
+                        or "_eval" in vars(k):
+                    impl = True
+                    break
+            if not impl:
+                findings.append(
+                    f"expr {cls.__name__}: {method} not implemented")
+
+    # 3. enable keys present
+    for rule_map, kind in ((EXEC_RULES, "exec"), (EXPR_RULES, "expr")):
+        for cls in rule_map:
+            key = f"spark.rapids.tpu.sql.{kind}.{cls.__name__}"
+            if lookup(key) is None:
+                findings.append(f"{kind} {cls.__name__}: enable key "
+                                f"{key} missing from config registry")
+    return findings
+
+
+def main() -> int:  # pragma: no cover - CLI entry
+    findings = validate()
+    if not findings:
+        print("API validation: clean "
+              "(execs, expressions, and enable keys all in sync)")
+        return 0
+    print(f"API validation: {len(findings)} finding(s)")
+    for f in findings:
+        print(f"  - {f}")
+    return 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())
