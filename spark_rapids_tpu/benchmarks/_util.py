@@ -1,0 +1,15 @@
+"""Shared helpers for the benchmark data generators."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+
+
+def schema_of(cols):
+    return T.Schema([T.Field(name, dtype) for name, dtype in cols])
+
+
+def pick(rng, n, choices):
+    """n seeded draws from a categorical vocabulary (object ndarray)."""
+    return np.array(choices, dtype=object)[rng.integers(0, len(choices), n)]

@@ -1,0 +1,109 @@
+"""Mortgage ETL benchmark (reference:
+integration_tests/.../mortgage/MortgageSpark.scala — the Fannie-Mae
+style ETL: clean the monthly performance records, derive per-loan
+delinquency aggregates, join with acquisition records, and emit the
+ML-ready feature frame).
+
+Two tables:
+  perf(loan_id, period, servicer, interest_rate, current_upb,
+       loan_age, delinquency_status)
+  acq(loan_id, orig_rate, orig_upb, orig_date_sk, seller, credit_score)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from ._util import pick as _pick, schema_of as _schema
+from ..plan import functions as F
+
+col = F.col
+lit = F.lit
+
+SELLERS = ["BANK OF AMERICA", "WELLS FARGO", "JPMORGAN", "CITI",
+           "QUICKEN", "OTHER"]
+
+
+def generate(sf: float = 0.01, seed: int = 31):
+    rng = np.random.default_rng(seed)
+    n_loan = max(20, int(100_000 * sf))
+    n_perf = n_loan * 12  # a year of monthly records per loan
+
+    loan = np.repeat(np.arange(1, n_loan + 1, dtype=np.int64), 12)
+    period = np.tile(np.arange(12, dtype=np.int32), n_loan)
+    # delinquency: mostly current, occasional 30/60/90+ day states
+    dlq = rng.choice([0, 0, 0, 0, 0, 0, 1, 2, 3], size=n_perf) \
+        .astype(np.int32)
+    upb0 = rng.uniform(50_000, 800_000, n_loan)
+    upb = (np.repeat(upb0, 12) * (1.0 - 0.002 * period)).round(2)
+    perf = {"loan_id": loan,
+            "period": period,
+            "servicer": _pick(rng, n_perf, SELLERS),
+            "interest_rate": np.round(
+                np.repeat(rng.uniform(2.5, 7.5, n_loan), 12), 3),
+            "current_upb": upb,
+            "loan_age": period,
+            "delinquency_status": dlq}
+    acq = {"loan_id": np.arange(1, n_loan + 1, dtype=np.int64),
+           "orig_rate": np.round(rng.uniform(2.5, 7.5, n_loan), 3),
+           "orig_upb": upb0.round(2),
+           "orig_date_sk": rng.integers(0, 1825, n_loan).astype(np.int64),
+           "seller": _pick(rng, n_loan, SELLERS),
+           "credit_score": rng.integers(450, 850, n_loan)
+           .astype(np.int32)}
+    return {
+        "perf": (_schema([("loan_id", T.INT64), ("period", T.INT32),
+                          ("servicer", T.STRING),
+                          ("interest_rate", T.FLOAT64),
+                          ("current_upb", T.FLOAT64),
+                          ("loan_age", T.INT32),
+                          ("delinquency_status", T.INT32)]), perf),
+        "acq": (_schema([("loan_id", T.INT64), ("orig_rate", T.FLOAT64),
+                         ("orig_upb", T.FLOAT64),
+                         ("orig_date_sk", T.INT64),
+                         ("seller", T.STRING),
+                         ("credit_score", T.INT32)]), acq),
+    }
+
+
+def dataframes(session, sf: float = 0.01, seed: int = 31):
+    return {name: session.create_dataframe(cols, schema)
+            for name, (schema, cols) in generate(sf, seed).items()}
+
+
+def etl(t):
+    """The ETL: per-loan delinquency aggregates joined back onto the
+    acquisition records, emitting the feature frame (reference:
+    MortgageSpark's createDelinquency + join with acquisition)."""
+    perf = t["perf"]
+    dlq = (perf.group_by(col("loan_id").alias("dl"))
+           .agg(F.max("delinquency_status").alias("worst_dlq"),
+                F.sum(F.if_(col("delinquency_status") >= lit(1),
+                            lit(1), lit(0))).alias("months_delinquent"),
+                F.min(F.if_(col("delinquency_status") >= lit(1),
+                            col("period"), lit(999)))
+                .alias("first_dlq_period"),
+                F.avg("current_upb").alias("avg_upb"),
+                F.count("*").alias("n_records")))
+    j = (t["acq"].join(dlq, on=(["loan_id"], ["dl"]), how="left")
+         .with_column("worst_dlq", F.coalesce(col("worst_dlq"), lit(0)))
+         .with_column("months_delinquent",
+                      F.coalesce(col("months_delinquent"), lit(0)))
+         .with_column("ever_90",
+                      F.if_(col("worst_dlq") >= lit(3), lit(1), lit(0)))
+         .with_column("rate_spread",
+                      col("orig_rate") - lit(4.0)))
+    return (j.select("loan_id", "seller", "credit_score", "orig_upb",
+                     "rate_spread", "worst_dlq", "months_delinquent",
+                     "first_dlq_period", "avg_upb", "ever_90")
+            .sort("loan_id"))
+
+
+def summary(t):
+    """Per-seller portfolio summary over the ETL output."""
+    return (etl(t).group_by("seller")
+            .agg(F.count("*").alias("loans"),
+                 F.avg("credit_score").alias("avg_score"),
+                 F.sum("ever_90").alias("ever_90_loans"),
+                 F.sum("orig_upb").alias("portfolio_upb"))
+            .sort("seller"))

@@ -1,0 +1,569 @@
+"""TPCx-BB-like queries 1-30 as DataFrame code.
+
+Reference analogue: ``integration_tests/.../tpcxbb/TpcxbbLikeSpark.scala``
+(Q1Like..Q30Like at :785-2065) — the ETL/SQL shape of each TPCx-BB query
+against the retail schema, expressed through this framework's DataFrame
+API.  As in the reference's "Like" suite, the ML/NLP stages of the
+original benchmark (clustering, classification, sentiment, NER) are
+reduced to their data-preparation SQL, and UDF-based sessionization is
+rewritten as join/window plans; magnitude thresholds are scaled for tiny
+generated data.
+
+Usage:
+    tables = tpcxbb_datagen.dataframes(session, sf=0.001)
+    rows = QUERIES[5](tables).collect()
+"""
+from __future__ import annotations
+
+from ..ops.windowexprs import over, row_number, window
+from ..plan import functions as F
+
+col = F.col
+lit = F.lit
+
+
+def _count_distinct(df, group_cols, distinct_col, out_name):
+    d = df.select(*(group_cols + [distinct_col])).distinct()
+    return d.group_by(*group_cols).agg(F.count(distinct_col).alias(out_name))
+
+
+def q1(t):
+    """Items frequently sold together in the same store basket
+    (self-join on ticket), per category pair count."""
+    ss = (t["store_sales"].select("ss_ticket_number", "ss_item_sk")
+          .join(t["item"].select("i_item_sk",
+                                 col("i_category_id").alias("cat_a")),
+                on=(["ss_item_sk"], ["i_item_sk"]), how="inner")
+          .select(col("ss_ticket_number").alias("tk_a"),
+                  col("ss_item_sk").alias("item_a"), "cat_a"))
+    ss2 = ss.select(col("tk_a").alias("tk_b"),
+                    col("item_a").alias("item_b"),
+                    col("cat_a").alias("cat_b"))
+    pairs = (ss.join(ss2, on=(["tk_a"], ["tk_b"]), how="inner")
+             .filter(col("item_a") < col("item_b")))
+    return (pairs.group_by("item_a", "item_b")
+            .agg(F.count("*").alias("cnt"))
+            .filter(col("cnt") >= lit(2))
+            .sort(col("cnt").desc(), col("item_a").asc(),
+                  col("item_b").asc())
+            .limit(100))
+
+
+def q2(t):
+    """Items clicked in the same session (user+day) as a target item."""
+    target = 1
+    wcs = t["web_clickstreams"].select("wcs_user_sk", "wcs_click_date_sk",
+                                       "wcs_item_sk")
+    with_target = (wcs.filter(col("wcs_item_sk") == lit(target))
+                   .select(col("wcs_user_sk").alias("u"),
+                           col("wcs_click_date_sk").alias("d"))
+                   .distinct())
+    return (wcs.join(with_target,
+                     on=(["wcs_user_sk", "wcs_click_date_sk"], ["u", "d"]),
+                     how="semi")
+            .filter(col("wcs_item_sk") != lit(target))
+            .group_by("wcs_item_sk")
+            .agg(F.count("*").alias("cnt"))
+            .sort(col("cnt").desc(), col("wcs_item_sk").asc())
+            .limit(30))
+
+
+def q3(t):
+    """Views of an item category by users who later purchased in it."""
+    buyers = (t["web_sales"]
+              .join(t["item"].select("i_item_sk", "i_category_id"),
+                    on=(["ws_item_sk"], ["i_item_sk"]), how="inner")
+              .select(col("ws_bill_customer_sk").alias("bu"),
+                      col("i_category_id").alias("bcat"))
+              .distinct())
+    views = (t["web_clickstreams"]
+             .join(t["item"].select(col("i_item_sk").alias("vi"),
+                                    "i_category_id"),
+                   on=(["wcs_item_sk"], ["vi"]), how="inner"))
+    return (views.join(buyers,
+                       on=(["wcs_user_sk", "i_category_id"],
+                           ["bu", "bcat"]), how="semi")
+            .group_by("i_category_id")
+            .agg(F.count("*").alias("views"))
+            .sort("i_category_id"))
+
+
+def q4(t):
+    """Sessions with clicks but no converting click (cart abandonment)."""
+    per_session = (t["web_clickstreams"]
+                   .group_by(col("wcs_user_sk").alias("u"),
+                             col("wcs_click_date_sk").alias("d"))
+                   .agg(F.count("*").alias("clicks"),
+                        F.max("wcs_sales_sk").alias("max_sale")))
+    return (per_session.filter(col("max_sale") == lit(0))
+            .agg(F.count("*").alias("abandoned_sessions"),
+                 F.avg("clicks").alias("avg_clicks")))
+
+
+def q5(t):
+    """Per-user category-click features vs college education (the
+    logistic-regression prep)."""
+    clicks = (t["web_clickstreams"]
+              .join(t["item"].select("i_item_sk", "i_category_id"),
+                    on=(["wcs_item_sk"], ["i_item_sk"]), how="inner"))
+    feat = (clicks.group_by(col("wcs_user_sk").alias("u"))
+            .agg(F.count("*").alias("total_clicks"),
+                 F.sum(F.if_(col("i_category_id") == lit(0),
+                             lit(1), lit(0))).alias("cat0_clicks")))
+    demo = (t["customer"]
+            .join(t["customer_demographics"],
+                  on=(["c_current_cdemo_sk"], ["cd_demo_sk"]), how="inner")
+            .select(col("c_customer_sk").alias("ck"),
+                    col("cd_education_status").alias("edu")))
+    return (feat.join(demo, on=(["u"], ["ck"]), how="inner")
+            .with_column("college",
+                         F.if_(col("edu").isin("College",
+                                               "Advanced Degree"),
+                               lit(1), lit(0)))
+            .group_by("college")
+            .agg(F.count("*").alias("users"),
+                 F.avg("total_clicks").alias("avg_clicks"),
+                 F.avg("cat0_clicks").alias("avg_cat0"))
+            .sort("college"))
+
+
+def q6(t):
+    """Customers whose web spend grew year-over-year (single-channel
+    reduction of the original's web-vs-store comparison)."""
+    dd = t["date_dim"].select("d_date_sk", "d_year")
+    ws = (t["web_sales"].join(dd, on=(["ws_sold_date_sk"], ["d_date_sk"]),
+                              how="inner")
+          .filter(col("d_year").isin(2001, 2002))
+          .group_by(col("ws_bill_customer_sk").alias("c"),
+                    col("d_year").alias("y"))
+          .agg(F.sum("ws_net_paid").alias("web_paid")))
+    w1 = (ws.filter(col("y") == lit(2001))
+          .select(col("c").alias("c1"), col("web_paid").alias("web_2001")))
+    w2 = (ws.filter(col("y") == lit(2002))
+          .select(col("c").alias("c2"), col("web_paid").alias("web_2002")))
+    return (w1.join(w2, on=(["c1"], ["c2"]), how="inner")
+            .filter(col("web_2002") > col("web_2001"))
+            .select("c1", "web_2001", "web_2002")
+            .sort(col("c1").asc())
+            .limit(100))
+
+
+def q7(t):
+    """States where >= K customers bought items priced over 1.2x their
+    category's average price."""
+    avg_cat = (t["item"].group_by(col("i_category_id").alias("cat"))
+               .agg(F.avg("i_current_price").alias("avg_price")))
+    pricey = (t["item"]
+              .join(avg_cat, on=(["i_category_id"], ["cat"]), how="inner")
+              .filter(col("i_current_price") > lit(1.2) * col("avg_price"))
+              .select(col("i_item_sk").alias("pi")))
+    buyers = (t["store_sales"]
+              .join(pricey, on=(["ss_item_sk"], ["pi"]), how="semi")
+              .select("ss_customer_sk").distinct())
+    located = (buyers
+               .join(t["customer"].select("c_customer_sk",
+                                          "c_current_addr_sk"),
+                     on=(["ss_customer_sk"], ["c_customer_sk"]),
+                     how="inner")
+               .join(t["customer_address"].select("ca_address_sk",
+                                                  "ca_state"),
+                     on=(["c_current_addr_sk"], ["ca_address_sk"]),
+                     how="inner"))
+    return (located.group_by("ca_state")
+            .agg(F.count("*").alias("cnt"))
+            .filter(col("cnt") >= lit(2))
+            .sort(col("cnt").desc(), col("ca_state").asc())
+            .limit(10))
+
+
+def q8(t):
+    """Web sales by users who previously wrote/read a review."""
+    reviewers = t["product_reviews"].select(
+        col("pr_user_sk").alias("ru")).distinct()
+    ws = t["web_sales"]
+    with_rev = ws.join(reviewers, on=(["ws_bill_customer_sk"], ["ru"]),
+                       how="semi")
+    return (with_rev.agg(F.sum("ws_net_paid").alias("reviewed_sales"),
+                         F.count("*").alias("n_rows")))
+
+
+def q9(t):
+    """Store sales aggregated under demographic filter combinations."""
+    j = (t["store_sales"]
+         .join(t["customer_demographics"],
+               on=(["ss_cdemo_sk"], ["cd_demo_sk"]), how="inner"))
+    m = ((col("cd_gender") == lit("M"))
+         & (col("cd_marital_status") == lit("M"))
+         & (col("cd_education_status") == lit("College")))
+    f_ = ((col("cd_gender") == lit("F"))
+          & (col("cd_marital_status") == lit("S")))
+    return (j.filter(m | f_)
+            .agg(F.sum("ss_quantity").alias("total_quantity"),
+                 F.count("*").alias("n")))
+
+
+def q10(t):
+    """Sentiment-ish: reviews containing positive words per item."""
+    pos = (t["product_reviews"]
+           .filter(col("pr_review_content").contains("great")
+                   | col("pr_review_content").contains("excellent")
+                   | col("pr_review_content").contains("love")))
+    return (pos.group_by("pr_item_sk")
+            .agg(F.count("*").alias("pos_reviews"),
+                 F.avg("pr_review_rating").alias("avg_rating"))
+            .filter(col("pos_reviews") >= lit(2))
+            .sort(col("pos_reviews").desc(), col("pr_item_sk").asc())
+            .limit(50))
+
+
+def q11(t):
+    """Per-item review stats joined with web sales (rating/sales corr
+    prep)."""
+    ratings = (t["product_reviews"]
+               .group_by(col("pr_item_sk").alias("ri"))
+               .agg(F.avg("pr_review_rating").alias("avg_rating"),
+                    F.count("*").alias("n_reviews")))
+    sales = (t["web_sales"].group_by(col("ws_item_sk").alias("si"))
+             .agg(F.sum("ws_net_paid").alias("sales")))
+    return (ratings.join(sales, on=(["ri"], ["si"]), how="inner")
+            .select("ri", "avg_rating", "n_reviews", "sales")
+            .sort(col("sales").desc(), col("ri").asc())
+            .limit(50))
+
+
+def q12(t):
+    """Users who clicked an item category and bought in-store in that
+    category within 60 days."""
+    clicks = (t["web_clickstreams"]
+              .join(t["item"].select("i_item_sk", "i_category_id"),
+                    on=(["wcs_item_sk"], ["i_item_sk"]), how="inner")
+              .select(col("wcs_user_sk").alias("u"),
+                      col("i_category_id").alias("ccat"),
+                      col("wcs_click_date_sk").alias("cdate")))
+    buys = (t["store_sales"]
+            .join(t["item"].select(col("i_item_sk").alias("bi"),
+                                   "i_category_id"),
+                  on=(["ss_item_sk"], ["bi"]), how="inner")
+            .select(col("ss_customer_sk").alias("b_u"),
+                    col("i_category_id").alias("bcat"),
+                    col("ss_sold_date_sk").alias("bdate")))
+    j = (clicks.join(buys, on=(["u", "ccat"], ["b_u", "bcat"]),
+                     how="inner")
+         .filter((col("bdate") >= col("cdate"))
+                 & (col("bdate") <= col("cdate") + lit(60))))
+    return _count_distinct(j, ["ccat"], "u", "converting_users") \
+        .sort("ccat")
+
+
+def q13(t):
+    """Customer year-over-year web sales ratio."""
+    dd = t["date_dim"].select("d_date_sk", "d_year")
+    per = (t["web_sales"]
+           .join(dd, on=(["ws_sold_date_sk"], ["d_date_sk"]), how="inner")
+           .filter(col("d_year").isin(2001, 2002))
+           .group_by(col("ws_bill_customer_sk").alias("c"))
+           .agg(F.sum(F.if_(col("d_year") == lit(2001),
+                            col("ws_net_paid"), lit(0.0))).alias("s1"),
+                F.sum(F.if_(col("d_year") == lit(2002),
+                            col("ws_net_paid"), lit(0.0))).alias("s2")))
+    return (per.filter(col("s1") > lit(0.0))
+            .select("c", "s1", "s2", (col("s2") / col("s1")).alias("ratio"))
+            .sort(col("ratio").desc(), col("c").asc())
+            .limit(100))
+
+
+def q14(t):
+    """Morning vs evening web click traffic ratio."""
+    wcs = t["web_clickstreams"]
+    morning = F.if_((col("wcs_click_time_sk") >= lit(7 * 3600))
+                    & (col("wcs_click_time_sk") < lit(9 * 3600)),
+                    lit(1), lit(0))
+    evening = F.if_((col("wcs_click_time_sk") >= lit(19 * 3600))
+                    & (col("wcs_click_time_sk") < lit(21 * 3600)),
+                    lit(1), lit(0))
+    return (wcs.agg(F.sum(morning).alias("am"), F.sum(evening).alias("pm"))
+            .select((col("am") * lit(1.0)
+                     / F.greatest(col("pm"), lit(1))).alias("am_pm_ratio")))
+
+
+def q15(t):
+    """Store category monthly sales slope sign (declining categories):
+    first vs second half-year totals."""
+    dd = t["date_dim"].select("d_date_sk", "d_year", "d_moy")
+    j = (t["store_sales"]
+         .join(dd, on=(["ss_sold_date_sk"], ["d_date_sk"]), how="inner")
+         .filter(col("d_year") == lit(2002))
+         .join(t["item"].select("i_item_sk", "i_category_id"),
+               on=(["ss_item_sk"], ["i_item_sk"]), how="inner"))
+    per = (j.group_by(col("i_category_id").alias("cat"))
+           .agg(F.sum(F.if_(col("d_moy") <= lit(6),
+                            col("ss_net_paid"), lit(0.0))).alias("h1"),
+                F.sum(F.if_(col("d_moy") > lit(6),
+                            col("ss_net_paid"), lit(0.0))).alias("h2")))
+    return (per.filter(col("h2") < col("h1"))
+            .select("cat", "h1", "h2")
+            .sort("cat"))
+
+
+def q16(t):
+    """Web sales net of returns around a pivot date."""
+    pivot = 600
+    ws = (t["web_sales"]
+          .filter((col("ws_sold_date_sk") >= lit(pivot - 30))
+                  & (col("ws_sold_date_sk") <= lit(pivot + 30))))
+    wr = t["web_returns"].select(
+        col("wr_order_number").alias("ro"),
+        col("wr_item_sk").alias("ri"),
+        col("wr_return_quantity").alias("rq"))
+    j = ws.join(wr, on=(["ws_order_number", "ws_item_sk"], ["ro", "ri"]),
+                how="left")
+    net = (col("ws_quantity") - F.coalesce(col("rq"), lit(0)))
+    return (j.agg(F.sum(col("ws_quantity")).alias("sold"),
+                  F.sum(net).alias("net_of_returns")))
+
+
+def q17(t):
+    """In-category share of a brand's store sales (promo-ratio shape)."""
+    j = (t["store_sales"]
+         .join(t["item"].select("i_item_sk", "i_category_id", "i_brand_id"),
+               on=(["ss_item_sk"], ["i_item_sk"]), how="inner"))
+    per = (j.group_by(col("i_category_id").alias("cat"))
+           .agg(F.sum(F.if_(col("i_brand_id") <= lit(10),
+                            col("ss_net_paid"), lit(0.0)))
+                .alias("brand_sales"),
+                F.sum("ss_net_paid").alias("all_sales")))
+    return (per.select("cat", (lit(100.0) * col("brand_sales")
+                               / col("all_sales")).alias("brand_pct"))
+            .sort("cat"))
+
+
+def q18(t):
+    """Stores with declining sales and their review exposure."""
+    dd = t["date_dim"].select("d_date_sk", "d_moy", "d_year")
+    per_store = (t["store_sales"]
+                 .join(dd, on=(["ss_sold_date_sk"], ["d_date_sk"]),
+                       how="inner")
+                 .filter(col("d_year") == lit(2002))
+                 .group_by(col("ss_store_sk").alias("st"))
+                 .agg(F.sum(F.if_(col("d_moy") <= lit(6),
+                                  col("ss_net_paid"), lit(0.0)))
+                      .alias("h1"),
+                      F.sum(F.if_(col("d_moy") > lit(6),
+                                  col("ss_net_paid"), lit(0.0)))
+                      .alias("h2")))
+    declining = per_store.filter(col("h2") < col("h1"))
+    return (declining.join(t["store"].select("s_store_sk", "s_store_name"),
+                           on=(["st"], ["s_store_sk"]), how="inner")
+            .select("s_store_name", "h1", "h2")
+            .sort("s_store_name"))
+
+
+def q19(t):
+    """Items with high return rates in both channels."""
+    sr = (t["store_returns"].group_by(col("sr_item_sk").alias("i1"))
+          .agg(F.sum("sr_return_quantity").alias("store_returned")))
+    wr = (t["web_returns"].group_by(col("wr_item_sk").alias("i2"))
+          .agg(F.sum("wr_return_quantity").alias("web_returned")))
+    return (sr.join(wr, on=(["i1"], ["i2"]), how="inner")
+            .select(col("i1").alias("item"), "store_returned",
+                    "web_returned")
+            .sort(col("store_returned").desc(), col("item").asc())
+            .limit(50))
+
+
+def q20(t):
+    """Customer return-behavior features (segmentation prep)."""
+    sales = (t["store_sales"].group_by(col("ss_customer_sk").alias("c"))
+             .agg(F.count("*").alias("orders"),
+                  F.sum("ss_net_paid").alias("spend")))
+    rets = (t["store_returns"].group_by(col("sr_customer_sk").alias("rc"))
+            .agg(F.count("*").alias("returns")))
+    j = sales.join(rets, on=(["c"], ["rc"]), how="left")
+    return (j.with_column("returns", F.coalesce(col("returns"), lit(0)))
+            .with_column("return_ratio",
+                         col("returns") * lit(1.0)
+                         / F.greatest(col("orders"), lit(1)))
+            .filter(col("return_ratio") > lit(0.2))
+            .select("c", "orders", "returns", "return_ratio")
+            .sort(col("return_ratio").desc(), col("c").asc())
+            .limit(100))
+
+
+def q21(t):
+    """Items returned and re-purchased by the same customer within 6
+    months (180 day-sks)."""
+    sr = t["store_returns"].select(
+        col("sr_customer_sk").alias("rc"), col("sr_item_sk").alias("ri"),
+        col("sr_returned_date_sk").alias("rd"))
+    again = (sr.join(t["store_sales"].select("ss_customer_sk",
+                                             "ss_item_sk",
+                                             "ss_sold_date_sk"),
+                     on=(["rc", "ri"], ["ss_customer_sk", "ss_item_sk"]),
+                     how="inner")
+             .filter((col("ss_sold_date_sk") > col("rd"))
+                     & (col("ss_sold_date_sk") <= col("rd") + lit(180))))
+    return _count_distinct(again, ["ri"], "rc", "repurchasers") \
+        .sort(col("repurchasers").desc(), col("ri").asc()).limit(50)
+
+
+def q22(t):
+    """Inventory on hand around a pivot date per warehouse."""
+    pivot = 900
+    inv = t["inventory"].filter(
+        (col("inv_date_sk") >= lit(pivot - 30))
+        & (col("inv_date_sk") <= lit(pivot + 30)))
+    per = (inv.group_by("inv_warehouse_sk")
+           .agg(F.sum(F.if_(col("inv_date_sk") < lit(pivot),
+                            col("inv_quantity_on_hand"), lit(0)))
+                .alias("before"),
+                F.sum(F.if_(col("inv_date_sk") >= lit(pivot),
+                            col("inv_quantity_on_hand"), lit(0)))
+                .alias("after")))
+    return (per.join(t["warehouse"].select("w_warehouse_sk",
+                                           "w_warehouse_name"),
+                     on=(["inv_warehouse_sk"], ["w_warehouse_sk"]),
+                     how="inner")
+            .select("w_warehouse_name", "before", "after")
+            .sort("w_warehouse_name"))
+
+
+def q23(t):
+    """Items whose inventory varies strongly across snapshots
+    (coefficient-of-variation shape, via mean/meansq aggregates)."""
+    per = (t["inventory"]
+           .group_by(col("inv_item_sk").alias("i"))
+           .agg(F.avg("inv_quantity_on_hand").alias("mean_q"),
+                F.avg(col("inv_quantity_on_hand")
+                      * col("inv_quantity_on_hand")).alias("meansq"),
+                F.count("*").alias("n")))
+    var = col("meansq") - col("mean_q") * col("mean_q")
+    return (per.filter(col("mean_q") > lit(0.0))
+            .with_column("cv", F.sqrt(F.greatest(var, lit(0.0)))
+                         / col("mean_q"))
+            .filter(col("cv") > lit(0.4))
+            .select("i", "mean_q", "cv")
+            .sort(col("cv").desc(), col("i").asc())
+            .limit(100))
+
+
+def q24(t):
+    """Sales before/after an item price threshold (elasticity shape)."""
+    cheap = t["item"].filter(col("i_current_price") < lit(50.0)) \
+        .select(col("i_item_sk").alias("ci"))
+    j = t["store_sales"].join(cheap, on=(["ss_item_sk"], ["ci"]),
+                              how="semi")
+    k = t["store_sales"].join(cheap, on=(["ss_item_sk"], ["ci"]),
+                              how="anti")
+    a = j.agg(F.sum("ss_quantity").alias("q")).select(
+        lit("cheap").alias("bucket"), col("q"))
+    b = k.agg(F.sum("ss_quantity").alias("q")).select(
+        lit("pricey").alias("bucket"), col("q"))
+    return a.union(b).sort("bucket")
+
+
+def q25(t):
+    """Customer RFM features (recency / frequency / monetary)."""
+    per = (t["store_sales"]
+           .group_by(col("ss_customer_sk").alias("c"))
+           .agg(F.max("ss_sold_date_sk").alias("last_day"),
+                F.count("*").alias("frequency"),
+                F.sum("ss_net_paid").alias("monetary")))
+    return (per.with_column("recent",
+                            F.if_(col("last_day") >= lit(1460),
+                                  lit(1), lit(0)))
+            .filter(col("frequency") >= lit(2))
+            .select("c", "recent", "frequency", "monetary")
+            .sort(col("monetary").desc(), col("c").asc())
+            .limit(100))
+
+
+def q26(t):
+    """Per-customer category-spend vector (clustering prep)."""
+    j = (t["store_sales"]
+         .join(t["item"].select("i_item_sk", "i_category_id"),
+               on=(["ss_item_sk"], ["i_item_sk"]), how="inner"))
+    catcol = [F.sum(F.if_(col("i_category_id") == lit(c),
+                          col("ss_net_paid"), lit(0.0))).alias(f"cat{c}")
+              for c in range(5)]
+    return (j.group_by(col("ss_customer_sk").alias("c"))
+            .agg(F.count("*").alias("n"), *catcol)
+            .filter(col("n") >= lit(3))
+            .sort(col("n").desc(), col("c").asc())
+            .limit(100))
+
+
+def q27(t):
+    """Reviews mentioning a competitor-ish keyword per item (NER
+    reduction)."""
+    hits = t["product_reviews"].filter(
+        col("pr_review_content").contains("refund")
+        | col("pr_review_content").contains("broken"))
+    return (hits.group_by("pr_item_sk")
+            .agg(F.count("*").alias("mentions"))
+            .sort(col("mentions").desc(), col("pr_item_sk").asc())
+            .limit(50))
+
+
+def q28(t):
+    """Rating-bucket counts per category (naive-bayes prep)."""
+    j = (t["product_reviews"]
+         .join(t["item"].select("i_item_sk", "i_category_id"),
+               on=(["pr_item_sk"], ["i_item_sk"]), how="inner"))
+    return (j.with_column("sentiment",
+                          F.when(col("pr_review_rating") >= lit(4),
+                                 lit("pos"))
+                          .when(col("pr_review_rating") == lit(3),
+                                lit("neutral"))
+                          .otherwise(lit("neg")))
+            .group_by("i_category_id", "sentiment")
+            .agg(F.count("*").alias("cnt"))
+            .sort("i_category_id", "sentiment"))
+
+
+def q29(t):
+    """Category pairs sold together in the same web order."""
+    ws = (t["web_sales"].select("ws_order_number", "ws_item_sk")
+          .join(t["item"].select("i_item_sk", "i_category_id"),
+                on=(["ws_item_sk"], ["i_item_sk"]), how="inner")
+          .select(col("ws_order_number").alias("o"),
+                  col("i_category_id").alias("cat_a"))
+          .distinct())
+    ws2 = ws.select(col("o").alias("o2"), col("cat_a").alias("cat_b"))
+    pairs = (ws.join(ws2, on=(["o"], ["o2"]), how="inner")
+             .filter(col("cat_a") < col("cat_b")))
+    return (pairs.group_by("cat_a", "cat_b")
+            .agg(F.count("*").alias("cnt"))
+            .sort(col("cnt").desc(), col("cat_a").asc(),
+                  col("cat_b").asc())
+            .limit(50))
+
+
+def q30(t):
+    """Category pairs viewed in the same session, ranked per category by
+    affinity (windowed top-N)."""
+    v = (t["web_clickstreams"]
+         .join(t["item"].select("i_item_sk", "i_category_id"),
+               on=(["wcs_item_sk"], ["i_item_sk"]), how="inner")
+         .select(col("wcs_user_sk").alias("u"),
+                 col("wcs_click_date_sk").alias("d"),
+                 col("i_category_id").alias("cat_a"))
+         .distinct())
+    v2 = v.select(col("u").alias("u2"), col("d").alias("d2"),
+                  col("cat_a").alias("cat_b"))
+    pairs = (v.join(v2, on=(["u", "d"], ["u2", "d2"]), how="inner")
+             .filter(col("cat_a") != col("cat_b"))
+             .group_by("cat_a", "cat_b")
+             .agg(F.count("*").alias("cnt")))
+    ranked = pairs.with_window(
+        "rn", over(row_number(),
+                   window().partition_by("cat_a")
+                   .order_by(col("cnt").desc(), col("cat_b").asc())))
+    return (ranked.filter(col("rn") <= lit(3))
+            .select("cat_a", "cat_b", "cnt", "rn")
+            .sort("cat_a", "rn"))
+
+
+QUERIES = {i: fn for i, fn in enumerate(
+    [q1, q2, q3, q4, q5, q6, q7, q8, q9, q10, q11, q12, q13, q14, q15,
+     q16, q17, q18, q19, q20, q21, q22, q23, q24, q25, q26, q27, q28,
+     q29, q30], start=1)}

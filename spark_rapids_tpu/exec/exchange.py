@@ -33,9 +33,14 @@ from ..utils.tracing import trace_range
 from .base import DevicePartitionedData, TpuExec
 
 
-def _free_shuffle_buffers(fw, store):
+def _free_shuffle_buffers(fw, store, spill_listener=None):
     for buf_id, _rr in (store[0] if store else ()):
         fw.remove_batch(buf_id)
+    if spill_listener is not None:
+        try:
+            fw.spill_listeners.remove(spill_listener)
+        except ValueError:
+            pass
 
 
 class TpuShuffleExchangeExec(TpuExec):
@@ -110,15 +115,12 @@ class TpuShuffleExchangeExec(TpuExec):
                 store.append(items)
             return store[0]
 
-        def evict_offdevice_pids():
-            # evict cached pids whose batch left the device tier — they
-            # are unspillable HBM otherwise and would defeat the spill
-            from ..memory.spill import StorageTier
+        # drop cached pids the moment their batch is spilled off the
+        # device — they are unspillable HBM and would defeat the spill
+        def on_spill(bid):
+            pid_cache.pop(bid, None)
 
-            for k in list(pid_cache):
-                bk = fw.catalog.get(k)
-                if bk is None or bk.tier != StorageTier.DEVICE:
-                    pid_cache.pop(k, None)
+        fw.spill_listeners.append(on_spill)
 
         def pids_of(buf_id, b, rr_start):
             cached = pid_cache.get(buf_id)
@@ -132,7 +134,6 @@ class TpuShuffleExchangeExec(TpuExec):
             def it():
                 import jax.numpy as jnp
 
-                evict_offdevice_pids()  # once per reader pass
                 for buf_id, rr_start in materialized():
                     b = fw.acquire_batch(buf_id)
                     try:
@@ -151,7 +152,7 @@ class TpuShuffleExchangeExec(TpuExec):
         # side is dropped (reference: per-shuffle cleanup in
         # ShuffleBufferCatalog; without this every query's shuffle data
         # stays resident for the life of the process)
-        weakref.finalize(result, _free_shuffle_buffers, fw, store)
+        weakref.finalize(result, _free_shuffle_buffers, fw, store, on_spill)
         return result
 
     def describe(self):

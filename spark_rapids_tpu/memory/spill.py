@@ -235,6 +235,10 @@ class SpillFramework:
         #: device tier (set by install()); every device-tier byte delta is
         #: reported so the alloc-pressure handler can fire.
         self.device_manager = None
+        #: callbacks fired with buf_id when a buffer is spilled off the
+        #: device tier (consumers drop derived device-side state, e.g.
+        #: the exchange's cached partition ids)
+        self.spill_listeners: List = []
 
     def _track_device(self, delta: int) -> None:
         dm = self.device_manager
@@ -335,6 +339,8 @@ class SpillFramework:
                 spilled += buf.size
                 self.metrics["spill_to_host"] += 1
                 self.metrics["bytes_spilled"] += buf.size
+                for cb in list(self.spill_listeners):
+                    cb(victim_id)
                 self._maybe_spill_host_to_disk()
         if spilled:
             log.info("spilled %d bytes device->host", spilled)

@@ -15,22 +15,18 @@ from ..data.column import DeviceBatch, HostBatch
 from ..exec.base import DevicePartitionedData
 from ..exec.transitions import DeviceToHostExec
 from ..plan import logical as L
-from ..plan.physical import ExecContext
 
 
 def export_device_batches(session, plan: L.LogicalPlan) -> List[DeviceBatch]:
     """Execute ``plan`` and return the final columnar stage's device
     batches without downloading them (the reference peels
     GpuColumnarToRowExec off the executed plan the same way)."""
-    phys = session.physical_plan(plan)
-    if session.capture_plans:
-        session._executed_plans.append(phys)
+    phys, ctx = session.prepare_execution(plan)
     # peel device->host transitions at the root so the final stage stays
     # on the device (reference: detectAndTagFinalColumnarOutput,
     # GpuTransitionOverrides.scala:256-261)
     while isinstance(phys, DeviceToHostExec):
         phys = phys.children[0]
-    ctx = ExecContext(session.conf, session)
     data = phys.execute_columnar(ctx) if hasattr(phys, "execute_columnar") \
         else phys.execute(ctx)
     out: List[DeviceBatch] = []
@@ -63,10 +59,10 @@ def to_feature_matrix(batches: List[DeviceBatch], columns=None):
         cols, valid = [], None
         for name in names:
             c = b.column(name)
-            cols.append(c.data.astype(jnp.float32))
+            cols.append(c.data[:n].astype(jnp.float32))
             v = c.validity[:n]
             valid = v if valid is None else (valid & v)
-        m = jnp.stack(cols, axis=1)[:n]
+        m = jnp.stack(cols, axis=1)
         if valid is not None and not bool(valid.all()):
             m = m[valid]
         mats.append(m)

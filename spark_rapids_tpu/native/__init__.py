@@ -34,7 +34,21 @@ def _build() -> bool:
                        check=True, capture_output=True, timeout=120)
         return True
     except Exception as e:  # noqa: BLE001
-        log.warning("native build failed (%s); using Python fallbacks", e)
+        # quiet only when an up-to-date .so exists (shipped-.so
+        # deployments without a toolchain); a missing or stale library
+        # is a real problem worth surfacing
+        src = os.path.join(_NATIVE_DIR, "src", "srt_native.cc")
+        fresh = (os.path.exists(_SO_PATH)
+                 and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src))
+        if fresh:
+            log.debug("native build failed (%s); existing library is "
+                      "current", e)
+        elif os.path.exists(_SO_PATH):
+            log.warning("native build failed (%s); loading STALE library "
+                        "older than its source", e)
+        else:
+            log.warning("native build failed (%s); using Python "
+                        "fallbacks", e)
         return False
 
 

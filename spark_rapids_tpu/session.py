@@ -101,11 +101,16 @@ class Session:
             phys = TpuTransitionOverrides(self.conf).apply(phys)
         return phys
 
-    def execute(self, plan: L.LogicalPlan) -> HostBatch:
+    def prepare_execution(self, plan: L.LogicalPlan):
+        """Plan + capture + context — the shared front half of execute
+        paths (incl. the ML columnar export)."""
         phys = self.physical_plan(plan)
         if self.capture_plans:
             self._executed_plans.append(phys)
-        ctx = ExecContext(self.conf, self)
+        return phys, ExecContext(self.conf, self)
+
+    def execute(self, plan: L.LogicalPlan) -> HostBatch:
+        phys, ctx = self.prepare_execution(plan)
         data = phys.execute(ctx)
         schema = phys.schema if len(phys.schema) else plan.schema
         return collect_batches(data, schema)

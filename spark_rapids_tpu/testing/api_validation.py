@@ -80,25 +80,33 @@ def validate() -> List[str]:
             findings.append(
                 f"exec {cls.__name__}: no TPU conversion rule registered")
 
-    # 2. expression dual-backend contract
+    # 2. expression dual-backend contract: each backend's entry point
+    # (eval_*) or kernel hook (do_*) must be overridden below the
+    # abstract template bases, else the device path raises
+    # NotImplementedError inside a jit trace at runtime
+    from ..ops.expression import (BinaryExpression, TernaryExpression,
+                                  UnaryExpression)
+
+    template_bases = {Expression, UnaryExpression, BinaryExpression,
+                      TernaryExpression}
     for cls in EXPR_RULES:
         if issubclass(cls, agg.AggregateExpression):
             continue  # interpreted by the aggregate exec, not evaluated
         if cls.__name__ in INTENTIONAL_HOST_EXPRS:
             continue
-        for method in ("eval_cpu", "eval_tpu"):
+        for entry, hook in (("eval_cpu", "do_cpu"),
+                            ("eval_tpu", "do_tpu")):
             impl = False
             for k in cls.__mro__:
-                if k is Expression:
+                if k in template_bases:
                     break
-                if method in vars(k) or f"{method}_with_nulls" in vars(k) \
-                        or "eval_with_nulls" in vars(k) \
-                        or "_eval" in vars(k):
+                if entry in vars(k) or hook in vars(k):
                     impl = True
                     break
             if not impl:
                 findings.append(
-                    f"expr {cls.__name__}: {method} not implemented")
+                    f"expr {cls.__name__}: neither {entry} nor {hook} "
+                    f"overridden below the template bases")
 
     # 3. enable keys present
     for rule_map, kind in ((EXEC_RULES, "exec"), (EXPR_RULES, "expr")):
