@@ -7,7 +7,8 @@ def register_rules(register_exec):
     operators land (aggregate, sort, join, exchange, window)."""
     import importlib
 
-    for name in ("aggregate", "sort", "joins", "exchange", "window"):
+    for name in ("aggregate", "sort", "joins", "exchange", "window",
+                 "generate"):
         try:
             mod = importlib.import_module(f".{name}", __package__)
         except ModuleNotFoundError as e:

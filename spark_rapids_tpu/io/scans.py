@@ -93,6 +93,7 @@ class FileScanExec(P.PhysicalPlan):
         self.max_rows = conf.get(READER_BATCH_SIZE_ROWS)
         self.max_bytes = conf.get(READER_BATCH_SIZE_BYTES)
         self.n_partitions = max(1, len(files))
+        self.metrics_skipped_groups = 0
 
     @property
     def schema(self):
@@ -107,8 +108,11 @@ class FileScanExec(P.PhysicalPlan):
 
             pf = pq.ParquetFile(path)
             cols = self._projected_names()
+            groups = self._prune_row_groups(pf)
+            if not groups:
+                return
             for rb in pf.iter_batches(batch_size=self.max_rows,
-                                      columns=cols):
+                                      row_groups=groups, columns=cols):
                 yield ac.arrow_to_host_batch(rb, self._schema)
         elif self.fmt == "orc":
             import pyarrow.orc as orc
@@ -130,6 +134,51 @@ class FileScanExec(P.PhysicalPlan):
     def _projected_names(self):
         return self._schema.names
 
+    def _prune_row_groups(self, pf):
+        """Keep row groups whose min-max statistics admit the pushed
+        predicates (reference: the footer row-group filtering in
+        GpuParquetScan.scala:316 reusing Spark's ParquetFilters)."""
+        preds = self.options.get("_scan_predicates") or []
+        n_groups = pf.metadata.num_row_groups
+        if not preds:
+            return list(range(n_groups))
+        col_idx = {pf.metadata.schema.column(i).name: i
+                   for i in range(pf.metadata.num_columns)}
+        kept = []
+        for g in range(n_groups):
+            rg = pf.metadata.row_group(g)
+            admit = True
+            for name, op, value in preds:
+                i = col_idx.get(name)
+                if i is None:
+                    continue
+                st = rg.column(i).statistics
+                if st is None or not st.has_min_max:
+                    continue
+                dtype = self._schema[self._schema.index_of(name)].dtype \
+                    if name in self._schema else None
+                lo = _stat_value(st.min, dtype)
+                hi = _stat_value(st.max, dtype)
+                try:
+                    if op == "==" and (value < lo or value > hi):
+                        admit = False
+                    elif op == "<" and lo >= value:
+                        admit = False
+                    elif op == "<=" and lo > value:
+                        admit = False
+                    elif op == ">" and hi <= value:
+                        admit = False
+                    elif op == ">=" and hi < value:
+                        admit = False
+                except TypeError:  # incomparable stats type: keep group
+                    pass
+                if not admit:
+                    break
+            if admit:
+                kept.append(g)
+        self.metrics_skipped_groups += n_groups - len(kept)
+        return kept
+
     def execute(self, ctx):
         def make(pid):
             return lambda: self._read_file(self.files[pid])
@@ -140,6 +189,22 @@ class FileScanExec(P.PhysicalPlan):
 
     def describe(self):
         return f"FileScan[{self.fmt}]({len(self.files)} files)"
+
+
+def _stat_value(v, dtype=None):
+    """Normalize a parquet statistics value to the engine's host
+    representation for the scan column's dtype: DATE32 -> int32 days
+    since epoch, TIMESTAMP -> int64 microseconds since epoch."""
+    import datetime as dt
+
+    if isinstance(v, dt.datetime):
+        if dtype is not None and dtype.id is T.TypeId.TIMESTAMP:
+            epoch = dt.datetime(1970, 1, 1, tzinfo=v.tzinfo)
+            return int((v - epoch).total_seconds() * 1_000_000)
+        v = v.date()
+    if isinstance(v, dt.date):
+        return (v - dt.date(1970, 1, 1)).days
+    return v
 
 
 def _split_to_target(batch: HostBatch, max_rows: int):

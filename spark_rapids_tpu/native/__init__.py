@@ -38,8 +38,11 @@ def _build() -> bool:
         # deployments without a toolchain); a missing or stale library
         # is a real problem worth surfacing
         src = os.path.join(_NATIVE_DIR, "src", "srt_native.cc")
+        # a shipped .so without sources counts as current
         fresh = (os.path.exists(_SO_PATH)
-                 and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src))
+                 and (not os.path.exists(src)
+                      or os.path.getmtime(_SO_PATH)
+                      >= os.path.getmtime(src)))
         if fresh:
             log.debug("native build failed (%s); existing library is "
                       "current", e)

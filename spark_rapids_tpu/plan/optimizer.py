@@ -1,0 +1,149 @@
+"""Logical optimizations ahead of physical planning.
+
+Reference analogue: the scan-pushdown half of GpuParquetScan /
+GpuOrcScan — column projection into the reader and predicate pushdown
+that prunes parquet row groups / ORC stripes by their min-max statistics
+(GpuParquetScan.scala:316 readPartFile's row-group filtering reusing
+Spark's ParquetFilters; OrcFilters.scala SARG pushdown).  The host SQL
+engine has no Catalyst doing this for us, so the two rewrites live here:
+
+  * prune_scan_columns: narrow every FileScan to the columns its
+    ancestors actually reference (the reader then decodes only those).
+  * push_scan_predicates: collect conjunctive ``col <op> literal``
+    predicates sitting directly above a scan and attach them to the scan
+    as advisory row-group filters; the Filter node stays in the plan
+    (stats pruning is sound but not complete).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from ..ops import predicates as pr
+from ..ops.expression import Expression, Literal, UnresolvedAttribute
+from . import logical as L
+
+
+def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
+    plan = prune_scan_columns(plan, set(plan.schema.names))
+    plan = push_scan_predicates(plan)
+    return plan
+
+
+# ==========================================================================
+# column pruning
+# ==========================================================================
+def _refs(exprs) -> Set[str]:
+    out: Set[str] = set()
+    for e in exprs:
+        out |= e.references()
+    return out
+
+
+def prune_scan_columns(node: L.LogicalPlan,
+                       required: Set[str]) -> L.LogicalPlan:
+    """Rebuild ``node`` with every reachable FileScan narrowed to the
+    columns required above it.  ``required`` is the set of this node's
+    output columns the parent needs."""
+    if isinstance(node, L.FileScan):
+        keep = [f for f in node.schema if f.name in required]
+        if 0 < len(keep) < len(node.schema):
+            return L.FileScan(node.fmt, node.paths,
+                              type(node.schema)(keep), node.options)
+        return node
+
+    if isinstance(node, L.Project):
+        child_req = _refs(node.exprs)
+        child = prune_scan_columns(node.children[0], child_req)
+        return L.Project(child, node.exprs)
+    if isinstance(node, L.Filter):
+        child_req = required | _refs([node.condition])
+        child = prune_scan_columns(node.children[0], child_req)
+        return L.Filter(child, node.condition)
+    if isinstance(node, L.Aggregate):
+        child_req = _refs(node.keys) | _refs(node.aggregates)
+        child = prune_scan_columns(node.children[0], child_req)
+        return L.Aggregate(child, node.keys, node.aggregates)
+    if isinstance(node, L.Sort):
+        child_req = required | _refs([k.expr for k in node.keys])
+        child = prune_scan_columns(node.children[0], child_req)
+        return L.Sort(child, node.keys, node.global_sort)
+    if isinstance(node, L.Limit):
+        child = prune_scan_columns(node.children[0], set(required))
+        return L.Limit(child, node.n)
+    if isinstance(node, L.Join):
+        need = (required | _refs(node.left_keys) | _refs(node.right_keys)
+                | (_refs([node.condition]) if node.condition is not None
+                   else set()))
+        lnames = set(node.children[0].schema.names)
+        rnames = set(node.children[1].schema.names)
+        left = prune_scan_columns(node.children[0], need & lnames)
+        right = prune_scan_columns(node.children[1], need & rnames)
+        return L.Join(left, right, node.left_keys, node.right_keys,
+                      node.how, node.condition)
+    if isinstance(node, L.Union):
+        children = [prune_scan_columns(c, set(required))
+                    for c in node.children]
+        return L.Union(children)
+    # conservative default: the child must keep every column
+    new_children = [prune_scan_columns(c, set(c.schema.names))
+                    for c in node.children]
+    if new_children != node.children:
+        import copy
+
+        node = copy.copy(node)
+        node.children = new_children
+    return node
+
+
+# ==========================================================================
+# predicate pushdown (row-group stats pruning)
+# ==========================================================================
+_CMP_OPS = {
+    pr.EqualTo: "==", pr.LessThan: "<", pr.LessThanOrEqual: "<=",
+    pr.GreaterThan: ">", pr.GreaterThanOrEqual: ">=",
+}
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
+
+ScanPredicate = Tuple[str, str, object]  # (column, op, literal value)
+
+
+def _conjuncts(e: Expression) -> List[Expression]:
+    if isinstance(e, pr.And):
+        return _conjuncts(e.children[0]) + _conjuncts(e.children[1])
+    return [e]
+
+
+def _as_scan_predicate(e: Expression) -> Optional[ScanPredicate]:
+    op = _CMP_OPS.get(type(e))
+    if op is None or len(e.children) != 2:
+        return None
+    a, b = e.children
+    if isinstance(a, UnresolvedAttribute) and isinstance(b, Literal) \
+            and b.value is not None:
+        return (a.attr_name, op, b.value)
+    if isinstance(b, UnresolvedAttribute) and isinstance(a, Literal) \
+            and a.value is not None:
+        return (b.attr_name, _FLIP[op], a.value)
+    return None
+
+
+def push_scan_predicates(node: L.LogicalPlan) -> L.LogicalPlan:
+    if isinstance(node, L.Filter) \
+            and isinstance(node.children[0], L.FileScan):
+        scan = node.children[0]
+        preds = [p for p in (_as_scan_predicate(c)
+                             for c in _conjuncts(node.condition))
+                 if p is not None and p[0] in scan.schema]
+        if preds:
+            new_scan = L.FileScan(scan.fmt, scan.paths, scan.schema,
+                                  dict(scan.options,
+                                       _scan_predicates=preds))
+            return L.Filter(new_scan, node.condition)
+        return node
+    new_children = [push_scan_predicates(c) for c in node.children]
+    if new_children != node.children:
+        import copy
+
+        node = copy.copy(node)
+        node.children = new_children
+    return node

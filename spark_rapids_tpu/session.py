@@ -92,7 +92,9 @@ class Session:
 
     # ----- execution -------------------------------------------------------
     def physical_plan(self, plan: L.LogicalPlan) -> PhysicalPlan:
-        phys = Planner(self.conf).plan(plan)
+        from .plan.optimizer import optimize
+
+        phys = Planner(self.conf).plan(optimize(plan))
         if self.conf.is_sql_enabled:
             from .plan.overrides import TpuOverrides
             from .plan.transitions import TpuTransitionOverrides

@@ -32,10 +32,6 @@ from ..plan import physical as P
 HOST_ONLY_EXECS = {
     "PhysicalPlan", "LocalScanExec", "HostToDeviceExec", "DeviceToHostExec",
     "DataWritingCommandExec", "CoalescePartitionsExec",
-    # explode generates data-dependent row counts per input row; runs on
-    # the host engine (device impl is an open item, like the reference's
-    # narrow GpuGenerateExec support for literal arrays only)
-    "GenerateExec",
 }
 
 # expressions whose device eval intentionally does not exist; their rules

@@ -1,0 +1,61 @@
+"""Device Generate (explode) vs CPU oracle.
+
+Reference analogue: GpuGenerateExec tests — explode of per-row literal
+element patterns, the statically-shaped case.
+"""
+import numpy as np
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import f
+
+
+def _sessions():
+    return srt.Session(), srt.Session(tpu_enabled=False)
+
+
+def _df(sess, n=50):
+    rng = np.random.default_rng(4)
+    return sess.create_dataframe({
+        "a": np.arange(n, dtype=np.int64),
+        "b": rng.random(n),
+        "s": np.array([f"x{i%7}" for i in range(n)], dtype=object),
+    }, n_partitions=2)
+
+
+def _check(build, expect_tpu=True):
+    tpu, cpu = _sessions()
+    qs = [build(_df(s)) for s in (tpu, cpu)]
+    if expect_tpu:
+        ex = qs[0].explain()
+        assert "GenerateExec -> will run on TPU" in ex, ex
+    assert qs[0].collect() == qs[1].collect()
+
+
+def test_explode_numeric_expressions():
+    _check(lambda df: df.explode(
+        [f.col("a"), f.col("a") * f.lit(10), f.lit(-1)], name="e"))
+
+
+def test_explode_preserves_row_major_order():
+    tpu, cpu = _sessions()
+    rows = tpu.create_dataframe({"a": np.array([7, 8])}) \
+        .explode([f.lit(1), f.lit(2), f.lit(3)], name="e").collect()
+    assert rows == [(7, 1), (7, 2), (7, 3), (8, 1), (8, 2), (8, 3)]
+
+
+def test_explode_strings():
+    _check(lambda df: df.explode(
+        [f.col("s"), f.lit("fixed"), f.concat(f.col("s"), f.lit("!"))],
+        name="e"))
+
+
+def test_explode_with_nulls():
+    _check(lambda df: df.explode(
+        [f.col("a"), f.lit(None, None), f.col("a") + f.lit(1)], name="e"))
+
+
+def test_explode_then_aggregate():
+    _check(lambda df: df.explode([f.col("a"), f.col("a") * f.lit(2)],
+                                 name="e")
+           .group_by("s").agg(f.sum("e").alias("t"))
+           .sort("s"))
