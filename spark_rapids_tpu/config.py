@@ -263,6 +263,11 @@ SHUFFLE_COMPRESS = conf("spark.rapids.tpu.shuffle.compress").doc(
     "Compress host-relay shuffle payloads").boolean_conf(False)
 SHUFFLE_PARTITIONS = conf("spark.rapids.tpu.sql.shuffle.partitions").doc(
     "Default number of exchange output partitions").int_conf(8)
+BROADCAST_THRESHOLD = conf(
+    "spark.rapids.tpu.sql.broadcastSizeThreshold").doc(
+    "Max estimated build-side bytes for a broadcast hash join (reference: "
+    "spark.sql.autoBroadcastJoinThreshold feeding GpuBroadcastMeta); "
+    "set to 0 to force shuffled joins").long_conf(10 * 1024 * 1024)
 
 # --- ML interop -----------------------------------------------------------
 EXPORT_COLUMNAR_RDD = conf("spark.rapids.tpu.sql.exportColumnarRdd").doc(

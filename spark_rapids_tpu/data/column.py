@@ -334,7 +334,10 @@ class DeviceBatch:
 # engine here is already columnar, so the boundary is numpy <-> jax).
 # --------------------------------------------------------------------------
 def host_to_device(batch: HostBatch, min_bucket_rows: int = 128,
-                   device=None) -> DeviceBatch:
+                   device=None, string_widths=None) -> DeviceBatch:
+    """``string_widths``: optional col-index -> byte-matrix width map so
+    several uploads share static string shapes (mesh stacking needs
+    every shard's columns shape-equal)."""
     import jax
     import jax.numpy as jnp
 
@@ -347,12 +350,13 @@ def host_to_device(batch: HostBatch, min_bucket_rows: int = 128,
         return jnp.asarray(arr)
 
     cols: List[DeviceColumn] = []
-    for c in batch.columns:
+    for ci, c in enumerate(batch.columns):
         valid_np = c.is_valid()
         validity = np.zeros(padded, dtype=np.bool_)
         validity[:n] = valid_np
         if c.dtype.id is TypeId.STRING:
-            bm, ln = dstrings.encode(c.data, c.validity)
+            width = (string_widths or {}).get(ci)
+            bm, ln = dstrings.encode(c.data, c.validity, max_len=width)
             bm, ln = dstrings.pad_rows(bm, ln, padded)
             cols.append(DeviceColumn(c.dtype, put(bm), put(validity), put(ln)))
         else:

@@ -118,6 +118,20 @@ class TpuHashJoinExec(TpuExec):
         c_out = bucket_rows(int(total))  # host sync: output sizing
         return self._expand_kernel(c_out, lb, rb, pr, emit, r_extra)
 
+    def join_static(self, lb: DeviceBatch, rb: DeviceBatch, c_out: int):
+        """Trace-safe join with a fixed output capacity (no host sync) —
+        the SPMD form used under shard_map by the distributed runner.
+        Returns ``(out_batch, total)``: ``total`` is the true match
+        count so the caller can detect capacity overflow and retry with
+        a larger ``c_out``."""
+        import jax.numpy as jnp
+
+        if self.how in ("semi", "anti"):
+            out = self._semi_anti(lb, rb)
+            return out, jnp.asarray(0, dtype=jnp.int64)
+        pr, emit, r_extra, total = self._count(lb, rb)
+        return self._expand(c_out, lb, rb, pr, emit, r_extra), total
+
     # ------------------------------------------------------------------
     def _one_batch(self, data, pid, side: int) -> DeviceBatch:
         from ..data.column import host_to_device

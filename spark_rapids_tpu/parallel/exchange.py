@@ -83,6 +83,23 @@ def _gather_tiles(batch: DeviceBatch, rows, valid) -> List[DeviceColumn]:
     return tiles
 
 
+def gather_replicate(batch: DeviceBatch, axis_name: str) -> DeviceBatch:
+    """Replicate every shard's rows onto every device — the mesh form of
+    the broadcast exchange (GpuBroadcastExchangeExec.scala:215: build
+    once, ship everywhere; here one `all_gather` over ICI)."""
+    import jax
+
+    present = jax.lax.all_gather(batch.row_mask(), axis_name, tiled=True)
+    cols = []
+    for c in batch.columns:
+        data = jax.lax.all_gather(c.data, axis_name, tiled=True)
+        validity = jax.lax.all_gather(c.validity, axis_name, tiled=True)
+        lengths = (jax.lax.all_gather(c.lengths, axis_name, tiled=True)
+                   if c.lengths is not None else None)
+        cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+    return _compact(cols, present, batch.schema)
+
+
 def _compact(batch_cols: List[DeviceColumn], present, schema) -> DeviceBatch:
     """Stable-move present rows to the front so the result is a normal
     DeviceBatch (logical rows first, padding after)."""
@@ -135,6 +152,23 @@ def collective_exchange(batch: DeviceBatch, pids, num_parts: int,
     return _compact(recv_cols, present, batch.schema)
 
 
+def squeeze_leading(b: DeviceBatch) -> DeviceBatch:
+    """Drop the per-shard leading axis inside shard_map: the stacked
+    [1, padded, ...] shard view -> a plain [padded, ...] DeviceBatch."""
+    cols = [DeviceColumn(c.dtype, c.data[0], c.validity[0],
+                         c.lengths[0] if c.lengths is not None else None)
+            for c in b.columns]
+    return DeviceBatch(b.schema, cols, b.num_rows.reshape(()))
+
+
+def unsqueeze_leading(b: DeviceBatch) -> DeviceBatch:
+    cols = [DeviceColumn(c.dtype, c.data[None], c.validity[None],
+                         c.lengths[None] if c.lengths is not None
+                         else None)
+            for c in b.columns]
+    return DeviceBatch(b.schema, cols, b.num_rows.reshape((1,)))
+
+
 def exchange_step(mesh, fn):
     """Wrap ``fn(local_batch) -> local_batch`` (which may call
     collective_exchange) in shard_map over the mesh's data axis,
@@ -144,21 +178,6 @@ def exchange_step(mesh, fn):
     from jax import shard_map
 
     axis = mesh.axis_names[0]
-
-    def squeeze_leading(b):
-        import jax.numpy as jnp
-
-        cols = [DeviceColumn(c.dtype, c.data[0], c.validity[0],
-                             c.lengths[0] if c.lengths is not None else None)
-                for c in b.columns]
-        return DeviceBatch(b.schema, cols, b.num_rows.reshape(()))
-
-    def unsqueeze_leading(b):
-        cols = [DeviceColumn(c.dtype, c.data[None], c.validity[None],
-                             c.lengths[None] if c.lengths is not None
-                             else None)
-                for c in b.columns]
-        return DeviceBatch(b.schema, cols, b.num_rows.reshape((1,)))
 
     def per_shard(stacked: DeviceBatch) -> DeviceBatch:
         return unsqueeze_leading(fn(squeeze_leading(stacked)))

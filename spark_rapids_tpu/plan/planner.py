@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..config import SHUFFLE_PARTITIONS
+from ..config import BROADCAST_THRESHOLD, SHUFFLE_PARTITIONS
 from ..ops.aggregates import AggregateExpression
 from ..ops.expression import Alias, Expression, output_name
 from ..shuffle.partitioning import (
@@ -24,13 +24,11 @@ from . import functions as F
 from . import logical as L
 from . import physical as P
 
-BROADCAST_THRESHOLD_BYTES = 10 * 1024 * 1024
-
-
 class Planner:
     def __init__(self, conf):
         self.conf = conf
         self.shuffle_partitions = conf.get(SHUFFLE_PARTITIONS)
+        self.broadcast_threshold = conf.get(BROADCAST_THRESHOLD)
 
     def plan(self, node: L.LogicalPlan) -> P.PhysicalPlan:
         fn = getattr(self, f"_plan_{type(node).__name__}", None)
@@ -155,7 +153,8 @@ class Planner:
         right = self.plan(node.children[1])
         est = self._estimate_bytes(node.children[1])
         can_broadcast = (est is not None
-                         and est <= BROADCAST_THRESHOLD_BYTES
+                         and self.broadcast_threshold > 0
+                         and est <= self.broadcast_threshold
                          and node.how in ("inner", "left", "semi", "anti"))
         if can_broadcast:
             return P.HashJoinExec(left, right, node.left_keys,
