@@ -1,64 +1,293 @@
-"""Driver benchmark: flagship TPC-H Q1-shaped pipeline on the TPU chip.
+"""Driver benchmark: TPC-H suite on the TPU engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-value = TPU pipeline throughput (million rows/s, end-to-end jitted
-filter->project->group-aggregate).  vs_baseline = speedup over the host
-(CPU oracle) engine running the identical query on the same data — the
-reference publishes no numbers (BASELINE.md), so the measured CPU
-engine is the working baseline, matching the reference's CPU-Spark-vs-
-plugin framing (README.md:18-20 bit-identical promise).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+value = aggregate effective throughput (GB/s of query input bytes) over
+five TPC-H queries — q1 (agg-heavy), q3/q5 (join-heavy), q6 (filter),
+q16 (strings + anti join) — end-to-end through the engine (host->device
+upload, device kernels, device->host collect), with the batch target
+lowered so multi-batch/out-of-core operator paths are exercised.
+
+vs_baseline = suite throughput over the best CPU engine per query: the
+in-repo host oracle vs a pandas (BLAS/numpy-backed) implementation of
+the same queries — the defensible external CPU baseline available in
+this image (reference frames vs CPU Spark, README.md:18-20).
+
+Extra fields (recorded alongside, same JSON object):
+  per_query:   best seconds / M input rows per s / GB/s per query
+  noise_pct:   per-query iteration spread (max-min)/best * 100
+  shuffle:     device shuffle-write microbench (tile prep for the
+               collective exchange, parallel/exchange.py) in GB/s
+  q1_pipeline: the historical single-kernel Q1 Mrows/s (r01/r02 metric)
 """
 import json
 import sys
 import time
 
+SF = 0.05
+QUERY_TABLES = {
+    1: ["lineitem"],
+    3: ["customer", "orders", "lineitem"],
+    5: ["region", "nation", "customer", "orders", "lineitem", "supplier"],
+    6: ["lineitem"],
+    16: ["part", "partsupp", "supplier"],
+}
+ITERS = 5
+# engage the chunked operator paths without drowning in tiny batches
+PRESSURE_CONF = {
+    "spark.rapids.tpu.sql.batchSizeBytes": 8 * 1024 * 1024,
+    "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 17,
+}
 
-def _host_engine_seconds(hb, iters=3):
-    from spark_rapids_tpu.models.flagship import q1_dataframe
-    from spark_rapids_tpu.session import Session
 
-    sess = Session(tpu_enabled=False)
-    df = q1_dataframe(sess, hb)
-    df.collect()  # warm any lazy init
-    best = float("inf")
+def _best(fn, iters=ITERS, warmup=1):
+    for _ in range(warmup):
+        fn()
+    times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        df.collect()
-        best = min(best, time.perf_counter() - t0)
-    return best
+        fn()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    noise = (max(times) - best) / best * 100.0
+    return best, noise
+
+
+def _pandas_tables(raw):
+    import pandas as pd
+
+    return {name: pd.DataFrame(
+        {c: v for c, v in cols.items()})
+        for name, (schema, cols) in raw.items()}
+
+
+def _d(y, m, d):
+    from spark_rapids_tpu.benchmarks.tpch_datagen import days
+
+    return days(y, m, d)
+
+
+def _pandas_queries():
+    import pandas as pd
+
+    def q1(t):
+        li = t["lineitem"]
+        li = li[li.l_shipdate <= _d(1998, 9, 2)].copy()
+        li["disc_price"] = li.l_extendedprice * (1.0 - li.l_discount)
+        li["charge"] = li.disc_price * (1.0 + li.l_tax)
+        g = li.groupby(["l_returnflag", "l_linestatus"]).agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_quantity", "count"))
+        return g.reset_index().sort_values(
+            ["l_returnflag", "l_linestatus"])
+
+    def q3(t):
+        cust = t["customer"]
+        cust = cust[cust.c_mktsegment == "BUILDING"][["c_custkey"]]
+        orders = t["orders"]
+        orders = orders[orders.o_orderdate < _d(1995, 3, 15)]
+        li = t["lineitem"]
+        li = li[li.l_shipdate > _d(1995, 3, 15)].copy()
+        j = cust.merge(orders, left_on="c_custkey", right_on="o_custkey")
+        j = j.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        j["revenue"] = j.l_extendedprice * (1.0 - j.l_discount)
+        g = (j.groupby(["o_orderkey", "o_orderdate", "o_shippriority"])
+             ["revenue"].sum().reset_index())
+        return g.sort_values(["revenue", "o_orderdate"],
+                             ascending=[False, True]).head(10)
+
+    def q5(t):
+        region = t["region"]
+        region = region[region.r_name == "ASIA"]
+        nation = t["nation"].merge(region, left_on="n_regionkey",
+                                   right_on="r_regionkey")
+        orders = t["orders"]
+        orders = orders[(orders.o_orderdate >= _d(1994, 1, 1))
+                        & (orders.o_orderdate < _d(1995, 1, 1))]
+        j = t["customer"].merge(nation[["n_nationkey", "n_name"]],
+                                left_on="c_nationkey",
+                                right_on="n_nationkey")
+        j = j[["c_custkey", "c_nationkey", "n_name"]].merge(
+            orders[["o_orderkey", "o_custkey"]],
+            left_on="c_custkey", right_on="o_custkey")
+        j = j.merge(t["lineitem"][["l_orderkey", "l_suppkey",
+                                   "l_extendedprice", "l_discount"]],
+                    left_on="o_orderkey", right_on="l_orderkey")
+        j = j.merge(t["supplier"][["s_suppkey", "s_nationkey"]],
+                    left_on=["l_suppkey", "c_nationkey"],
+                    right_on=["s_suppkey", "s_nationkey"])
+        j = j.copy()
+        j["revenue"] = j.l_extendedprice * (1.0 - j.l_discount)
+        return (j.groupby("n_name")["revenue"].sum().reset_index()
+                .sort_values("revenue", ascending=False))
+
+    def q6(t):
+        li = t["lineitem"]
+        m = ((li.l_shipdate >= _d(1994, 1, 1))
+             & (li.l_shipdate < _d(1995, 1, 1))
+             & (li.l_discount >= 0.05) & (li.l_discount <= 0.07)
+             & (li.l_quantity < 24.0))
+        sel = li[m]
+        return pd.DataFrame(
+            {"revenue": [(sel.l_extendedprice * sel.l_discount).sum()]})
+
+    def q16(t):
+        part = t["part"]
+        part = part[(part.p_brand != "Brand#45")
+                    & ~part.p_type.str.startswith("MEDIUM POLISHED")
+                    & part.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+        bad = t["supplier"]
+        bad = bad[bad.s_comment.str.contains("Customer Complaints")]
+        ps = t["partsupp"][["ps_partkey", "ps_suppkey"]]
+        ps = ps[~ps.ps_suppkey.isin(bad.s_suppkey)]
+        j = ps.merge(part[["p_partkey", "p_brand", "p_type", "p_size"]],
+                     left_on="ps_partkey", right_on="p_partkey")
+        g = (j.groupby(["p_brand", "p_type", "p_size"])["ps_suppkey"]
+             .nunique().reset_index(name="supplier_cnt"))
+        return g.sort_values(
+            ["supplier_cnt", "p_brand", "p_type", "p_size"],
+            ascending=[False, True, True, True])
+
+    return {1: q1, 3: q3, 5: q5, 6: q6, 16: q16}
+
+
+def _table_bytes(raw):
+    from spark_rapids_tpu.data.column import HostBatch
+
+    out = {}
+    for name, (schema, cols) in raw.items():
+        hb = HostBatch.from_pydict({c: v for c, v in cols.items()}, schema)
+        out[name] = hb.estimate_bytes()
+    return out
+
+
+def _shuffle_microbench():
+    """Device shuffle-write path: partition ids + tile prep for the
+    collective exchange (the map-side contiguousSplit analogue)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.data.column import HostBatch, host_to_device
+    from spark_rapids_tpu.parallel import exchange as X
+
+    n = 1 << 20
+    rng = np.random.RandomState(0)
+    hb = HostBatch.from_pydict({
+        "k": rng.randint(0, 1 << 30, n).astype(np.int64),
+        "a": rng.rand(n),
+        "b": rng.rand(n),
+        "c": rng.randint(0, 100, n).astype(np.int64),
+    })
+    db = host_to_device(hb)
+    nbytes = db.device_bytes()
+    P = 8
+    cap = db.padded_rows  # worst-case capacity, no row loss
+
+    def write_path(batch):
+        pids = X.device_partition_ids(batch, [0], P)
+        rows, valid = X.bucket_rows(pids, P, cap)
+        return X._gather_tiles(batch, rows, valid)
+
+    jfn = jax.jit(write_path)
+    out = jfn(db)
+    jax.block_until_ready(out)
+
+    def run():
+        jax.block_until_ready(jfn(db))
+
+    best, noise = _best(run, iters=ITERS)
+    return {"gb_per_s": round(nbytes / best / 1e9, 3),
+            "rows": n, "bytes": nbytes, "noise_pct": round(noise, 1)}
+
+
+def _q1_pipeline_mrows():
+    import jax
+
+    from spark_rapids_tpu.models.flagship import build_q1_pipeline
+
+    n_rows = 1 << 20
+    fn, example = build_q1_pipeline(n_rows=n_rows, seed=0)
+    jfn = jax.jit(fn)
+    jfn(example).block_until_ready()
+
+    def run():
+        jfn(example).block_until_ready()
+
+    best, noise = _best(run, iters=ITERS)
+    return {"mrows_per_s": round(n_rows / best / 1e6, 1),
+            "noise_pct": round(noise, 1)}
 
 
 def main():
-    n_rows = 1 << 20
-    import jax
-
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.benchmarks.tpch_datagen import generate
     from spark_rapids_tpu.data.column import register_pytrees
-    from spark_rapids_tpu.models.flagship import (build_q1_pipeline,
-                                                  lineitem_like)
+    from spark_rapids_tpu.session import Session
 
     register_pytrees()
-    fn, example = build_q1_pipeline(n_rows=n_rows, seed=0)
-    jfn = jax.jit(fn)
-    out = jfn(example)  # compile + first run
-    out.block_until_ready()
+    raw = generate(SF, seed=42)
+    sizes = _table_bytes(raw)
+    pq = _pandas_queries()
+    pt = _pandas_tables(raw)
 
-    iters = 10
-    best = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jfn(example).block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-    tpu_mrows = n_rows / best / 1e6
+    tpu = Session(dict(PRESSURE_CONF))
+    cpu = Session(dict(PRESSURE_CONF), tpu_enabled=False)
 
-    hb = lineitem_like(n_rows, seed=0)
-    cpu_s = _host_engine_seconds(hb)
-    cpu_mrows = n_rows / cpu_s / 1e6
+    def mk_tables(sess):
+        return {name: sess.create_dataframe(
+            {c: v for c, v in cols.items()}, schema)
+            for name, (schema, cols) in raw.items()}
+
+    t_tpu = mk_tables(tpu)
+    t_cpu = mk_tables(cpu)
+
+    per_query = {}
+    tot_bytes = tot_tpu_s = tot_cpu_s = 0.0
+    for qn, tables in QUERY_TABLES.items():
+        qbytes = sum(sizes[t] for t in tables)
+        df = tpch.QUERIES[qn](t_tpu)
+        tpu_s, noise = _best(lambda: df.collect())
+
+        # CPU side: best of (in-repo host oracle, pandas)
+        cdf = tpch.QUERIES[qn](t_cpu)
+        host_s, _ = _best(lambda: cdf.collect(), iters=1, warmup=0)
+        pd_s, _ = _best(lambda: pq[qn](pt), iters=3, warmup=1)
+        cpu_s = min(host_s, pd_s)
+
+        per_query[f"q{qn}"] = {
+            "tpu_s": round(tpu_s, 4),
+            "gb_per_s": round(qbytes / tpu_s / 1e9, 3),
+            "noise_pct": round(noise, 1),
+            "cpu_best_s": round(cpu_s, 4),
+            "cpu_engine": "host" if host_s <= pd_s else "pandas",
+            "speedup": round(cpu_s / tpu_s, 2),
+        }
+        tot_bytes += qbytes
+        tot_tpu_s += tpu_s
+        tot_cpu_s += cpu_s
+
+    suite_gbs = tot_bytes / tot_tpu_s / 1e9
+    cpu_gbs = tot_bytes / tot_cpu_s / 1e9
 
     print(json.dumps({
-        "metric": "tpch_q1_pipeline_throughput",
-        "value": round(tpu_mrows, 3),
-        "unit": "Mrows/s",
-        "vs_baseline": round(tpu_mrows / cpu_mrows, 3),
+        "metric": "tpch_suite_throughput",
+        "value": round(suite_gbs, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(suite_gbs / cpu_gbs, 3),
+        "sf": SF,
+        "queries": sorted(QUERY_TABLES),
+        "iters": ITERS,
+        "per_query": per_query,
+        "shuffle_write": _shuffle_microbench(),
+        "q1_pipeline": _q1_pipeline_mrows(),
     }))
 
 

@@ -148,16 +148,11 @@ HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.tpu.memory.host.spillStorageSize").
     1024 * 1024 * 1024)
 DEVICE_MEMORY_DEBUG = conf("spark.rapids.tpu.memory.debug").doc(
     "Log device allocations/frees").boolean_conf(False)
-PINNED_POOL_SIZE = conf("spark.rapids.tpu.memory.pinnedPool.size").doc(
-    "Bytes of page-locked host staging memory for device transfers "
-    "(advisory on TPU; transfers go through the runtime)").long_conf(0)
 
 # --- scheduling -----------------------------------------------------------
 CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
     "Number of tasks that may hold the device semaphore concurrently "
     "(reference: spark.rapids.sql.concurrentGpuTasks)").int_conf(2)
-SHUFFLE_SPILL_THREADS = conf("spark.rapids.tpu.shuffle.spillThreads").doc(
-    "Threads used to spill shuffle data to disk in the background").int_conf(6)
 TASK_THREADS = conf("spark.rapids.tpu.sql.taskThreads").doc(
     "Host task-runner threads per process (partition-level data "
     "parallelism)").int_conf(8)
@@ -184,52 +179,13 @@ INCOMPATIBLE_OPS = conf("spark.rapids.tpu.sql.incompatibleOps.enabled").doc(
     "Allow ops whose results may diverge from the host engine in corner "
     "cases (reference: spark.rapids.sql.incompatibleOps.enabled)").boolean_conf(False)
 ALLOW_FLOAT_AGG = conf("spark.rapids.tpu.sql.variableFloatAgg.enabled").doc(
-    "Allow float aggregation despite non-deterministic ordering of "
-    "partial results").boolean_conf(False)
-HAS_NANS = conf("spark.rapids.tpu.sql.hasNans").doc(
-    "Assume float data may contain NaNs (gates some comparisons/joins)"
+    "Allow floating-point aggregation on device.  Device partial sums "
+    "reduce in segment order, which differs from the host oracle's "
+    "order, so extreme values (±max, ±inf) can produce different — "
+    "equally valid — float results (reference: "
+    "spark.rapids.sql.variableFloatAgg.enabled; default true here "
+    "because the device order is deterministic for a fixed plan)"
 ).boolean_conf(True)
-ALLOW_FLOAT64_AS_32 = conf("spark.rapids.tpu.sql.float64AsFloat32.enabled").doc(
-    "On TPU generations without fp64 ALUs, compute double columns in "
-    "float32 (documented incompatibility)").boolean_conf(False)
-CAST_STRING_TO_FLOAT = conf("spark.rapids.tpu.sql.castStringToFloat.enabled").doc(
-    "Enable string->float casts (corner-case divergences documented)"
-).boolean_conf(False)
-CAST_FLOAT_TO_STRING = conf("spark.rapids.tpu.sql.castFloatToString.enabled").doc(
-    "Enable float->string casts (formatting divergences documented)"
-).boolean_conf(False)
-CAST_STRING_TO_TIMESTAMP = conf(
-    "spark.rapids.tpu.sql.castStringToTimestamp.enabled").doc(
-    "Enable string->timestamp casts").boolean_conf(False)
-CAST_STRING_TO_INTEGER = conf(
-    "spark.rapids.tpu.sql.castStringToInteger.enabled").doc(
-    "Enable string->integral casts").boolean_conf(False)
-IMPROVED_FLOAT_OPS = conf("spark.rapids.tpu.sql.improvedFloatOps.enabled").doc(
-    "Use faster float paths that may differ in ULPs from the host engine"
-).boolean_conf(False)
-ENABLE_REPLACE_SORT_MERGE_JOIN = conf(
-    "spark.rapids.tpu.sql.replaceSortMergeJoin.enabled").doc(
-    "Replace host sort-merge joins with device joins; on TPU the device "
-    "join itself is sort-based (reference replaces SMJ with hash join — "
-    "the efficient frontier is reversed on TPU)").boolean_conf(True)
-ENABLE_PARQUET = conf("spark.rapids.tpu.sql.format.parquet.enabled").doc(
-    "Enable Parquet scans/writes").boolean_conf(True)
-ENABLE_PARQUET_READ = conf("spark.rapids.tpu.sql.format.parquet.read.enabled").doc(
-    "Enable Parquet scans").boolean_conf(True)
-ENABLE_PARQUET_WRITE = conf("spark.rapids.tpu.sql.format.parquet.write.enabled").doc(
-    "Enable Parquet writes").boolean_conf(True)
-ENABLE_ORC = conf("spark.rapids.tpu.sql.format.orc.enabled").doc(
-    "Enable ORC scans/writes").boolean_conf(True)
-ENABLE_ORC_READ = conf("spark.rapids.tpu.sql.format.orc.read.enabled").doc(
-    "Enable ORC scans").boolean_conf(True)
-ENABLE_ORC_WRITE = conf("spark.rapids.tpu.sql.format.orc.write.enabled").doc(
-    "Enable ORC writes").boolean_conf(True)
-ENABLE_CSV = conf("spark.rapids.tpu.sql.format.csv.enabled").doc(
-    "Enable CSV scans").boolean_conf(True)
-ENABLE_CSV_READ = conf("spark.rapids.tpu.sql.format.csv.read.enabled").doc(
-    "Enable CSV scans").boolean_conf(True)
-FULL_TIMESTAMP_PARSE = conf("spark.rapids.tpu.sql.csv.read.timestamps.enabled").doc(
-    "Enable CSV timestamp parsing").boolean_conf(False)
 
 # --- test hooks (:456-463) ------------------------------------------------
 TEST_ENABLED = conf("spark.rapids.tpu.sql.test.enabled").doc(
@@ -242,9 +198,6 @@ TEST_ALLOWED_NON_TPU = conf("spark.rapids.tpu.sql.test.allowedNonTpu").doc(
 # --- debug ----------------------------------------------------------------
 EXPLAIN = conf("spark.rapids.tpu.sql.explain").doc(
     "Plan-rewrite explain mode: NONE, ALL, or NOT_ON_TPU").string_conf("NONE")
-DEBUG_DUMP_PREFIX = conf("spark.rapids.tpu.sql.debug.dumpPrefix").doc(
-    "If set, dump input batches of failing ops under this path prefix"
-).string_conf("")
 
 # --- aggregation modes (:483-493) ----------------------------------------
 HASH_AGG_REPLACE_MODE = conf("spark.rapids.tpu.sql.hashAgg.replaceMode").doc(
@@ -252,15 +205,10 @@ HASH_AGG_REPLACE_MODE = conf("spark.rapids.tpu.sql.hashAgg.replaceMode").doc(
 
 # --- shuffle / exchange (spark.rapids.shuffle.* :500-576) -----------------
 SHUFFLE_TRANSPORT_CLASS = conf("spark.rapids.tpu.shuffle.transport.class").doc(
-    "Transport used for device-to-device exchange; default is the ICI "
-    "collective transport (reference default is the UCX transport)"
+    "Transport used for device-to-device exchange, instantiated by "
+    "reflection like the reference's makeTransport "
+    "(RapidsConf.scala:505); the default rides ICI collectives"
 ).string_conf("spark_rapids_tpu.parallel.collective.IciCollectiveTransport")
-SHUFFLE_MAX_INFLIGHT = conf(
-    "spark.rapids.tpu.shuffle.maxReceiveInflightBytes").doc(
-    "Throttle on concurrently in-flight receive bytes for the host relay "
-    "path").long_conf(1024 * 1024 * 1024)
-SHUFFLE_COMPRESS = conf("spark.rapids.tpu.shuffle.compress").doc(
-    "Compress host-relay shuffle payloads").boolean_conf(False)
 SHUFFLE_PARTITIONS = conf("spark.rapids.tpu.sql.shuffle.partitions").doc(
     "Default number of exchange output partitions").int_conf(8)
 BROADCAST_THRESHOLD = conf(

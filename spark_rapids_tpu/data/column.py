@@ -370,6 +370,31 @@ def host_to_device(batch: HostBatch, min_bucket_rows: int = 128,
     return DeviceBatch(batch.schema, cols, n)
 
 
+def slice_device_batch(batch: DeviceBatch, start: int, stop: int,
+                       min_bucket_rows: int = 128) -> DeviceBatch:
+    """Row-range view [start, stop) of a device batch, re-bucketed to its
+    own padded size (used to cut sorted runs into spillable tiles)."""
+    import jax.numpy as jnp
+
+    n = stop - start
+    padded = bucket_rows(n, min_bucket_rows)
+    cols: List[DeviceColumn] = []
+    for c in batch.columns:
+        validity = jnp.zeros(padded, dtype=jnp.bool_
+                             ).at[:n].set(c.validity[start:stop])
+        if c.lengths is not None:
+            data = jnp.zeros((padded, c.data.shape[1]), dtype=c.data.dtype
+                             ).at[:n].set(c.data[start:stop])
+            lengths = jnp.zeros(padded, dtype=c.lengths.dtype
+                                ).at[:n].set(c.lengths[start:stop])
+            cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+        else:
+            data = jnp.zeros(padded, dtype=c.data.dtype
+                             ).at[:n].set(c.data[start:stop])
+            cols.append(DeviceColumn(c.dtype, data, validity))
+    return DeviceBatch(batch.schema, cols, n)
+
+
 def device_to_host(batch: DeviceBatch) -> HostBatch:
     n = int(batch.num_rows)
     cols: List[HostColumn] = []

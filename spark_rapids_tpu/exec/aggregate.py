@@ -25,7 +25,7 @@ from ..ops.kernels import gather as G
 from ..ops.kernels import segment as seg
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
-from .base import DevicePartitionedData, RequireSingleBatch, TpuExec
+from .base import DevicePartitionedData, TpuExec
 
 
 def _string_minmax_device(col: DeviceColumn, valid, seg_ids,
@@ -54,7 +54,14 @@ def _string_minmax_device(col: DeviceColumn, valid, seg_ids,
 
 class TpuHashAggregateExec(TpuExec):
     """Sort-based group-by on device; wraps the host plan node to reuse its
-    bound keys/specs/schema (modes are identical)."""
+    bound keys/specs/schema (modes are identical).
+
+    Out-of-core: a partition bigger than the batch-size goal arrives as
+    several batches; each is aggregated to its buffer form and merged into
+    a running grouped result — the same concat+merge loop the reference
+    runs per batch (aggregate.scala:240-335).  The running result is
+    registered with the spill catalog between merges so memory pressure
+    can evict it."""
 
     def __init__(self, child, plan):
         super().__init__([child])
@@ -65,21 +72,58 @@ class TpuHashAggregateExec(TpuExec):
         self._schema = plan.schema
         import jax
 
-        self._kernel = jax.jit(self._compute)
+        self._kernel = jax.jit(self.compute_batch)
+        # chunked-path kernels (used only when a partition spans batches)
+        self._update_kernel = jax.jit(
+            lambda b: self._compute(b, "update", "buffers"))
+        self._merge_kernel = jax.jit(
+            lambda b: self._compute(b, "merge", "buffers"))
+        self._merge_final_kernel = jax.jit(
+            lambda b: self._compute(b, "merge", emit))
+
+    def compute_batch(self, batch: DeviceBatch) -> DeviceBatch:
+        """The mode's full aggregation over one batch (trace-safe; also
+        the per-shard form the distributed runner lowers through)."""
+        phase = "merge" if self.mode == "final" else "update"
+        emit = "buffers" if self.mode == "partial" else "final"
+        return self._compute(batch, phase, emit)
 
     @property
     def schema(self):
         return self._schema
 
     @property
+    def buffer_schema(self) -> T.Schema:
+        """Schema of the pre-finalize form: group keys + agg buffers
+        (for a partial agg this IS the output schema)."""
+        from ..plan.physical import _buffer_fields
+
+        nkeys = len(self.keys)
+        if self.mode == "partial":
+            return self._schema
+        key_fields = [
+            T.Field(f.name, k.dtype)
+            for f, k in zip(self._schema.fields[:nkeys], self.keys)
+        ] if self.mode == "complete" else \
+            list(self.children[0].schema.fields[:nkeys])
+        return T.Schema(key_fields + _buffer_fields(self.specs))
+
+    @property
     def children_coalesce_goal(self):
-        # one sort amortizes over all rows in the partition (reference
-        # instead loops concat+merge per batch; single-batch is the
-        # TPU-friendly equivalent until size goals demand chunking)
-        return [RequireSingleBatch()]
+        # chunked concat+merge handles multi-batch partitions; the goal is
+        # the session batch-size target (reference: aggregate.scala loops
+        # concat+merge per batch at the same goal)
+        from .base import TargetSize
+
+        return [TargetSize()]
 
     # ------------------------------------------------------------------
-    def _compute(self, batch: DeviceBatch) -> DeviceBatch:
+    def _compute(self, batch: DeviceBatch, phase: str,
+                 emit: str) -> DeviceBatch:
+        """One aggregation pass.  ``phase``: "update" evaluates key/value
+        expressions over raw input rows; "merge" treats the batch as
+        buffer-form (keys + buffers).  ``emit``: "buffers" outputs the
+        grouped buffer form; "final" applies the finalize expressions."""
         import jax
         import jax.numpy as jnp
 
@@ -88,7 +132,7 @@ class TpuHashAggregateExec(TpuExec):
         rm = batch.row_mask()
 
         # ----- keys ----------------------------------------------------
-        if self.mode == "final":
+        if phase == "merge":
             key_cols = [batch.columns[i] for i in range(nkeys)]
         else:
             key_cols = [as_device_column(k.eval_tpu(batch), padded)
@@ -128,7 +172,7 @@ class TpuHashAggregateExec(TpuExec):
             out_keys.append(g)
 
         # ----- reductions ----------------------------------------------
-        if self.mode in ("partial", "complete"):
+        if phase == "update":
             buffers = self._update_buffers(
                 batch, order, pad_sorted, seg_ids, padded, out_valid_seg)
         else:
@@ -136,9 +180,9 @@ class TpuHashAggregateExec(TpuExec):
                 batch, order, pad_sorted, seg_ids, padded, out_valid_seg,
                 nkeys)
 
-        if self.mode == "partial":
+        if emit == "buffers":
             out_cols = out_keys + buffers
-            return DeviceBatch(self._schema, out_cols, n_real)
+            return DeviceBatch(self.buffer_schema, out_cols, n_real)
         return self._finalize(out_keys, buffers, n_real, padded,
                               out_valid_seg)
 
@@ -249,29 +293,62 @@ class TpuHashAggregateExec(TpuExec):
         return DeviceBatch(self._schema, out_cols, n_real)
 
     # ------------------------------------------------------------------
+    def _agg_chunked(self, first: DeviceBatch, rest) -> DeviceBatch:
+        """Out-of-core path: per-batch buffer-form agg + running merge
+        (reference: aggregate.scala:240-335 concat+merge loop).  The
+        running result sits in the spill catalog between merges so the
+        alloc-pressure handler can evict it while the next input batch
+        is being produced/aggregated."""
+        from ..memory.spill import SpillFramework, SpillPriorities
+        from .coalesce import concat_device_batches
+
+        fw = SpillFramework.get()
+        to_buffers = (lambda b: b) if self.mode == "final" \
+            else self._update_kernel
+        running = to_buffers(first)
+        for nxt in rest:
+            rid = fw.add_batch(running,
+                               priority=SpillPriorities.ACTIVE_ON_DECK)
+            part = to_buffers(nxt)
+            run_dev = fw.acquire_batch(rid)
+            combined = concat_device_batches([run_dev, part])
+            fw.release_batch(rid)
+            fw.remove_batch(rid)
+            running = self._merge_kernel(combined)
+        if self.mode == "partial":
+            return running
+        # re-merging the grouped running result is the identity on every
+        # buffer (one row per segment), so this pass just re-groups and
+        # applies the finalize expressions
+        return self._merge_final_kernel(running)
+
     def execute_columnar(self, ctx):
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
 
         def make(pid):
             def it():
-                batches = list(child.iterator(pid))
-                if not batches:
+                batches = child.iterator(pid)
+                first = next(batches, None)
+                if first is None:
                     if self.keys or self.mode == "partial":
                         return
                     # global agg over empty input still yields one row
                     from ..data.column import host_to_device
                     from ..plan.physical import _empty_batch
 
-                    batches = [host_to_device(
-                        _empty_batch(self.children[0].schema))]
-                from .coalesce import concat_device_batches
-
-                batch = concat_device_batches(batches) \
-                    if len(batches) > 1 else batches[0]
+                    first = host_to_device(
+                        _empty_batch(self.children[0].schema))
+                second = next(batches, None)
                 with trace_range("TpuHashAggregate",
                                  self.metrics[M.TOTAL_TIME]):
-                    out = self._kernel(batch)
+                    if second is None:
+                        out = self._kernel(first)
+                    else:
+                        from itertools import chain
+
+                        out = self._agg_chunked(
+                            first, chain([second], batches))
                 self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
                 yield out
 
@@ -289,7 +366,35 @@ class TpuHashAggregateExec(TpuExec):
 # rule registration
 # ==========================================================================
 def register(register_exec):
+    from ..config import HASH_AGG_REPLACE_MODE
     from ..plan import physical as P
+
+    def tag(meta):
+        from ..config import ALLOW_FLOAT_AGG
+
+        if not meta.conf.get(ALLOW_FLOAT_AGG):
+            # reference: GpuHashAggregateMeta rejects float aggregation
+            # unless variableFloatAgg is enabled (order-dependent sums)
+            for sp in meta.plan.specs:
+                child = sp.func.child
+                if child is not None and child.dtype.is_floating:
+                    meta.will_not_work_on_tpu(
+                        f"aggregation over floating column "
+                        f"({sp.func.sql()}) disabled; enable "
+                        "spark.rapids.tpu.sql.variableFloatAgg.enabled")
+                    break
+        # reference: hashAgg.replaceMode gates which modes convert
+        # (aggregate.scala GpuHashAggregateMeta + RapidsConf:483-493)
+        allowed = str(meta.conf.get(HASH_AGG_REPLACE_MODE)).lower()
+        if allowed != "all":
+            modes = {m.strip() for m in allowed.split("|")}
+            mode = meta.plan.mode
+            if mode == "complete":
+                mode = "partial"  # complete ~ single-phase partial+final
+            if mode not in modes:
+                meta.will_not_work_on_tpu(
+                    f"aggregation mode {meta.plan.mode} excluded by "
+                    f"hashAgg.replaceMode={allowed}")
 
     def exprs_of(plan: P.HashAggregateExec):
         out = list(plan.keys)
@@ -301,4 +406,5 @@ def register(register_exec):
         P.HashAggregateExec,
         convert=lambda meta, ch: TpuHashAggregateExec(ch[0], meta.plan),
         desc="sort-based segment-reduce group-by on TPU",
+        tag=tag,
         exprs_of=exprs_of)

@@ -12,7 +12,7 @@ executable — the static-shape answer to cudf's dynamic kernels.
 """
 from __future__ import annotations
 
-from typing import Callable, Iterator, List, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence
 
 from .. import types as T
 from ..data.column import DeviceBatch
@@ -29,12 +29,20 @@ class CoalesceGoal:
                 isinstance(other, RequireSingleBatch):
             return RequireSingleBatch()
         if isinstance(self, TargetSize) and isinstance(other, TargetSize):
+            if self.target is None:
+                return self
+            if other.target is None:
+                return other
             return self if self.target >= other.target else other
         return self
 
 
 class TargetSize(CoalesceGoal):
-    def __init__(self, target: int):
+    """``target=None`` means "use the session's batchSizeBytes" — the goal
+    declared by out-of-core operators that chunk their input (reference:
+    TargetSize(conf.gpuTargetBatchSizeBytes))."""
+
+    def __init__(self, target: Optional[int] = None):
         self.target = target
 
     def __repr__(self):  # pragma: no cover

@@ -80,7 +80,9 @@ class TpuCoalesceBatchesExec(TpuExec):
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
         min_bucket = ctx.conf.get(BUCKET_MIN_ROWS)
-        target = self.goal.target if isinstance(self.goal, TargetSize) \
+        target = self.goal.target \
+            if isinstance(self.goal, TargetSize) \
+            and self.goal.target is not None \
             else ctx.conf.get(BATCH_SIZE_BYTES)
 
         def make(pid):

@@ -87,9 +87,12 @@ class TpuShuffleExchangeExec(TpuExec):
 
         from ..memory.spill import SpillFramework
 
+        import threading
+
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
         store: List[list] = []
+        write_lock = threading.Lock()  # concurrent readers, one writer
         # buf_id -> (id(device_batch), pids): partition ids are computed
         # once per resident batch and reused by all n_out readers; a
         # spill+promote cycle yields a new batch object and recomputes
@@ -100,20 +103,21 @@ class TpuShuffleExchangeExec(TpuExec):
             """Shuffle write: batches registered as spillable in the
             device store (reference: RapidsCachingWriter keeps map
             output in HBM, spillable under pressure)."""
-            if not store:
-                items = []  # (buffer id, round-robin start offset)
-                rr = 0
-                with trace_range("TpuShuffleWrite",
-                                 self.metrics[M.TOTAL_TIME]):
-                    for pid in range(child.n_partitions):
-                        for b in child.iterator(pid):
-                            n = int(b.num_rows)
-                            if n == 0:
-                                continue
-                            items.append((fw.add_batch(b), rr))
-                            rr = (rr + n) % self.n_out
-                store.append(items)
-            return store[0]
+            with write_lock:
+                if not store:
+                    items = []  # (buffer id, round-robin start offset)
+                    rr = 0
+                    with trace_range("TpuShuffleWrite",
+                                     self.metrics[M.TOTAL_TIME]):
+                        for pid in range(child.n_partitions):
+                            for b in child.iterator(pid):
+                                n = int(b.num_rows)
+                                if n == 0:
+                                    continue
+                                items.append((fw.add_batch(b), rr))
+                                rr = (rr + n) % self.n_out
+                    store.append(items)
+                return store[0]
 
         # drop cached pids the moment their batch is spilled off the
         # device — they are unspillable HBM and would defeat the spill

@@ -76,6 +76,71 @@ class TpuHashJoinExec(TpuExec):
         return [RequireSingleBatch(), RequireSingleBatch()]
 
     # ------------------------------------------------------------------
+    # out-of-core: grace partitioning (both sides split by key hash into
+    # sub-buckets that fit the batch target; equal keys colocate, so each
+    # bucket pair joins independently for every join type)
+    # ------------------------------------------------------------------
+    def _bucket_side(self, batches, key_exprs, m: int, fw) -> List[List[int]]:
+        """Split each batch into ``m`` key-hash buckets, registering every
+        sub-batch with the spill catalog.  Returns per-bucket buf-id
+        lists."""
+        import jax.numpy as jnp
+
+        from ..data.column import slice_device_batch
+        from ..memory.spill import SpillPriorities
+        from ..utils import hashing
+
+        buckets: List[List[int]] = [[] for _ in range(m)]
+        for b in batches:
+            padded = b.padded_rows
+            keys = [as_device_column(k.eval_tpu(b), padded)
+                    for k in key_exprs]
+            h = hashing.hash_device_batch(keys)
+            pids = hashing.pmod(h, m).astype(jnp.int32)
+            for i in range(m):
+                sub = compact(b, pids == i)
+                cnt = int(sub.num_rows)
+                if cnt == 0:
+                    continue
+                sub = slice_device_batch(sub, 0, cnt)
+                buckets[i].append(fw.add_batch(
+                    sub, priority=SpillPriorities.output_for_read()))
+        return buckets
+
+    def _take_bucket(self, buf_ids: List[int], side: int, fw) -> DeviceBatch:
+        from ..data.column import host_to_device
+        from ..plan.physical import _empty_batch
+
+        if not buf_ids:
+            return host_to_device(_empty_batch(self.children[side].schema))
+        parts = []
+        for bid in buf_ids:
+            parts.append(fw.acquire_batch(bid))
+            fw.release_batch(bid)
+            fw.remove_batch(bid)
+        return concat_device_batches(parts) if len(parts) > 1 else parts[0]
+
+    def _join_grace(self, l_batches, r_batches, total_bytes: int,
+                    target: int):
+        """Join sides too big for one batch pair: hash both into the same
+        bucket space and join bucket-wise (the spill-aware analogue of the
+        reference's RequireSingleBatch build side)."""
+        from ..memory.spill import SpillFramework
+
+        fw = SpillFramework.get()
+        m = 2
+        while m * target < total_bytes and m < 64:
+            m <<= 1
+        l_buckets = self._bucket_side(l_batches, self.left_keys, m, fw)
+        r_buckets = self._bucket_side(r_batches, self.right_keys, m, fw)
+        for i in range(m):
+            if not l_buckets[i] and not r_buckets[i]:
+                continue
+            lb = self._take_bucket(l_buckets[i], 0, fw)
+            rb = self._take_bucket(r_buckets[i], 1, fw)
+            yield self._metrics_wrap(lambda: self._join(lb, rb))
+
+    # ------------------------------------------------------------------
     def _keys_of(self, batch: DeviceBatch, exprs):
         return [as_device_column(k.eval_tpu(batch), batch.padded_rows)
                 for k in exprs]
@@ -133,17 +198,6 @@ class TpuHashJoinExec(TpuExec):
         return self._expand(c_out, lb, rb, pr, emit, r_extra), total
 
     # ------------------------------------------------------------------
-    def _one_batch(self, data, pid, side: int) -> DeviceBatch:
-        from ..data.column import host_to_device
-        from ..plan.physical import _empty_batch
-
-        batches = list(data.iterator(pid))
-        if not batches:
-            return host_to_device(
-                _empty_batch(self.children[side].schema))
-        return concat_device_batches(batches) \
-            if len(batches) > 1 else batches[0]
-
     def execute_columnar(self, ctx):
         raise NotImplementedError
 
@@ -157,7 +211,15 @@ class TpuHashJoinExec(TpuExec):
 
 class TpuShuffledHashJoinExec(TpuHashJoinExec):
     """Both sides co-partitioned by the exchange; joins partition-wise
-    (reference: GpuShuffledHashJoinExec.doExecuteColumnar:88)."""
+    (reference: GpuShuffledHashJoinExec.doExecuteColumnar:88).  A
+    partition pair that exceeds the batch target joins out-of-core via
+    grace hash bucketing instead of demanding a single batch."""
+
+    @property
+    def children_coalesce_goal(self):
+        from .base import TargetSize
+
+        return [TargetSize(), TargetSize()]
 
     def execute_columnar(self, ctx):
         left = self.children[0].execute_columnar(ctx)
@@ -165,17 +227,35 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
         self._init_metrics(ctx)
         assert left.n_partitions == right.n_partitions, \
             "shuffled join requires co-partitioned children"
+        target = ctx.conf.batch_size_bytes
 
         def make(pid):
             def it():
-                lb = self._one_batch(left, pid, 0)
-                rb = self._one_batch(right, pid, 1)
-                yield self._metrics_wrap(lambda: self._join(lb, rb))
+                l_batches = list(left.iterator(pid))
+                r_batches = list(right.iterator(pid))
+                total = sum(b.device_bytes()
+                            for b in l_batches + r_batches)
+                if len(l_batches) <= 1 and len(r_batches) <= 1:
+                    lb = self._of(l_batches, 0)
+                    rb = self._of(r_batches, 1)
+                    yield self._metrics_wrap(lambda: self._join(lb, rb))
+                    return
+                yield from self._join_grace(l_batches, r_batches,
+                                            total, target)
 
             return it
 
         return DevicePartitionedData(
             [make(i) for i in range(left.n_partitions)])
+
+    def _of(self, batches, side: int) -> DeviceBatch:
+        from ..data.column import host_to_device
+        from ..plan.physical import _empty_batch
+
+        if not batches:
+            return host_to_device(_empty_batch(self.children[side].schema))
+        return concat_device_batches(batches) \
+            if len(batches) > 1 else batches[0]
 
     def describe(self):
         return f"TpuShuffledHashJoin[{self.how}]"
@@ -186,40 +266,68 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
     against every stream partition (reference:
     GpuBroadcastHashJoinExec.doExecuteColumnar:115 — the broadcast
     re-upload becomes a device concat; on a mesh the build side is
-    replicated, the XLA analogue of the broadcast exchange)."""
+    replicated, the XLA analogue of the broadcast exchange).  The stream
+    side is NOT coalesced to one batch: every join type this exec allows
+    (inner/left/semi/anti, planner gate) is row-local on the stream side,
+    so batches stream through independently."""
+
+    @property
+    def children_coalesce_goal(self):
+        from .base import TargetSize
+
+        # build side keeps the single-batch demand, as the reference does
+        return [TargetSize(), RequireSingleBatch()]
 
     def execute_columnar(self, ctx):
+        import threading
+
         left = self.children[0].execute_columnar(ctx)
         right = self.children[1].execute_columnar(ctx)
         self._init_metrics(ctx)
         built = []  # lazily built once, shared by all partitions
+        build_lock = threading.Lock()
 
         def build() -> DeviceBatch:
-            if not built:
-                batches = []
-                for pid in range(right.n_partitions):
-                    batches.extend(right.iterator(pid))
-                if batches:
-                    built.append(concat_device_batches(batches)
-                                 if len(batches) > 1 else batches[0])
-                else:
-                    from ..data.column import host_to_device
-                    from ..plan.physical import _empty_batch
+            with build_lock:
+                if not built:
+                    batches = []
+                    for pid in range(right.n_partitions):
+                        batches.extend(right.iterator(pid))
+                    if batches:
+                        built.append(concat_device_batches(batches)
+                                     if len(batches) > 1 else batches[0])
+                    else:
+                        from ..data.column import host_to_device
+                        from ..plan.physical import _empty_batch
 
-                    built.append(host_to_device(
-                        _empty_batch(self.children[1].schema)))
-            return built[0]
+                        built.append(host_to_device(
+                            _empty_batch(self.children[1].schema)))
+                return built[0]
 
         def make(pid):
             def it():
-                lb = self._one_batch(left, pid, 0)
-                rb = build()
-                yield self._metrics_wrap(lambda: self._join(lb, rb))
+                streamed = False
+                for lb in left.iterator(pid):
+                    streamed = True
+                    rb = build()
+                    yield self._metrics_wrap(
+                        lambda: self._join(lb, rb))
+                if not streamed:
+                    lb = self._one_batch_empty(0)
+                    rb = build()
+                    yield self._metrics_wrap(
+                        lambda: self._join(lb, rb))
 
             return it
 
         return DevicePartitionedData(
             [make(i) for i in range(left.n_partitions)])
+
+    def _one_batch_empty(self, side: int) -> DeviceBatch:
+        from ..data.column import host_to_device
+        from ..plan.physical import _empty_batch
+
+        return host_to_device(_empty_batch(self.children[side].schema))
 
     def describe(self):
         return f"TpuBroadcastHashJoin[{self.how}]"

@@ -9,11 +9,33 @@ GpuParquetScan.scala:554)."""
 from __future__ import annotations
 
 from ..data.column import device_to_host, host_to_device
-from ..config import BUCKET_MIN_ROWS
+from ..config import (BUCKET_MIN_ROWS, READER_BATCH_SIZE_BYTES,
+                      READER_BATCH_SIZE_ROWS)
 from ..plan.physical import PartitionedData
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
 from .base import DevicePartitionedData, TpuExec
+
+
+def _split_host_batch(batch, max_rows: int, max_bytes: int):
+    """Slice an oversize host batch to the reader size targets before
+    upload (reference: populateCurrentBlockChunk batching row groups by
+    reader.batchSizeRows/Bytes, GpuParquetScan.scala:571) — this is what
+    makes multi-batch partitions, and with them the out-of-core operator
+    paths, actually occur."""
+    n = batch.num_rows
+    if n == 0:
+        yield batch
+        return
+    rows_cap = max(1, max_rows)
+    est = batch.estimate_bytes()
+    if est > max_bytes:
+        rows_cap = min(rows_cap, max(1, int(n * max_bytes / est)))
+    if rows_cap >= n:
+        yield batch
+        return
+    for start in range(0, n, rows_cap):
+        yield batch.slice(start, min(start + rows_cap, n))
 
 
 class HostToDeviceExec(TpuExec):
@@ -36,18 +58,22 @@ class HostToDeviceExec(TpuExec):
         self._init_metrics(ctx)
         sem = self._sem(ctx)
         min_rows = ctx.conf.get(BUCKET_MIN_ROWS)
+        max_rows = ctx.conf.get(READER_BATCH_SIZE_ROWS)
+        max_bytes = ctx.conf.get(READER_BATCH_SIZE_BYTES)
 
         def make(pid):
             def it():
                 for batch in child_data.iterator(pid):
-                    if sem:
-                        sem.acquire_if_necessary()
-                    with trace_range("HostToDevice",
-                                     self.metrics[M.TOTAL_TIME]):
-                        db = host_to_device(batch, min_rows)
-                    self.metrics[M.NUM_OUTPUT_ROWS].add(batch.num_rows)
-                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
-                    yield db
+                    for hb in _split_host_batch(batch, max_rows,
+                                                max_bytes):
+                        if sem:
+                            sem.acquire_if_necessary()
+                        with trace_range("HostToDevice",
+                                         self.metrics[M.TOTAL_TIME]):
+                            db = host_to_device(hb, min_rows)
+                        self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
+                        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                        yield db
 
             return it
 

@@ -29,6 +29,16 @@ class DeviceSemaphore:
             if self._held.count == 0:
                 self._sem.release()
 
+    def release_all(self) -> None:
+        """Drop this thread's entire hold — the task-completion release
+        (reference: GpuSemaphore's task-completion listener,
+        GpuSemaphore.scala:101-160).  The underlying permit is held once
+        per thread regardless of the reentrancy count."""
+        count = getattr(self._held, "count", 0)
+        if count > 0:
+            self._held.count = 0
+            self._sem.release()
+
     def __enter__(self):
         self.acquire_if_necessary()
         return self

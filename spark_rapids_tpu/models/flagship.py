@@ -85,8 +85,13 @@ def _compute_chain(phys) -> List[Callable]:
     def walk(p):
         for c in p.children:
             walk(c)
-        if isinstance(p, TpuExec) and hasattr(p, "_compute"):
-            chain.append(p._compute)
+        if not isinstance(p, TpuExec):
+            return
+        fn = getattr(p, "compute_batch", None)
+        if fn is None and hasattr(p, "_compute"):
+            fn = p._compute
+        if fn is not None:
+            chain.append(fn)
 
     walk(phys)
     return chain

@@ -1,10 +1,11 @@
 """Cast expression — the full primitive cast matrix.
 
 Capability parity with the reference's GpuCast.scala (all primitive casts
-including string<->numeric/timestamp, with divergence-prone directions
-gated by confs exactly as the reference gates them:
-castStringToFloat/castFloatToString/castStringToTimestamp/
-castStringToInteger, RapidsConf.scala:373-403).
+including string<->numeric/timestamp).  Where the reference gates its
+divergence-prone GPU string-cast directions behind confs
+(castStringToFloat/castFloatToString/..., RapidsConf.scala:373-403), this
+engine routes every string-involved cast to the host oracle instead
+(``tpu_supported`` below) — same results, no divergence, no gate needed.
 
 Spark (non-ANSI) semantics implemented here:
   * int -> narrower int: bit truncation (Java narrowing)
@@ -125,7 +126,15 @@ def _sat_float_to_int(data: np.ndarray, dst: T.DType):
 
 def _host_cast(data: np.ndarray, valid: np.ndarray, src: T.DType,
                dst: T.DType):
-    """Returns (out_data, extra_null_mask_or_None)."""
+    """Returns (out_data, extra_null_mask_or_None).  Integral downcasts
+    deliberately wrap (Spark cast semantics) and invalid lanes carry
+    arbitrary data, so numpy's overflow/invalid warnings are noise here."""
+    with np.errstate(over="ignore", invalid="ignore"):
+        return _host_cast_impl(data, valid, src, dst)
+
+
+def _host_cast_impl(data: np.ndarray, valid: np.ndarray, src: T.DType,
+                    dst: T.DType):
     sid, did = src.id, dst.id
     # ---------- from string ----------
     if src.is_string:

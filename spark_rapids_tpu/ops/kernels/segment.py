@@ -178,7 +178,11 @@ def segment_reduce_np(values: np.ndarray, valid: np.ndarray,
         acc_t = np.float64 if np.issubdtype(values.dtype, np.floating) \
             else np.int64
         acc = np.zeros(n_segments, dtype=acc_t)
-        np.add.at(acc, seg_ids, np.where(valid, values, 0).astype(acc_t))
+        # long sums wrap on overflow (Spark semantics) and float sums may
+        # hit inf-inf: both are intended, not numeric accidents
+        with np.errstate(over="ignore", invalid="ignore"):
+            np.add.at(acc, seg_ids,
+                      np.where(valid, values, 0).astype(acc_t))
         return acc, counts > 0
     if op in ("min", "max"):
         if values.dtype == object:  # strings: python reduce per segment
@@ -199,8 +203,9 @@ def segment_reduce_np(values: np.ndarray, valid: np.ndarray,
             fill = info.max if op == "min" else info.min
             acc = np.full(n_segments, fill, dtype=values.dtype)
         red = _NP_REDUCE[op]
-        red.at(acc, seg_ids, np.where(valid, values,
-                                      values.dtype.type(fill)))
+        with np.errstate(invalid="ignore"):
+            red.at(acc, seg_ids, np.where(valid, values,
+                                          values.dtype.type(fill)))
         return acc, counts > 0
     raise ValueError(op)
 
@@ -249,12 +254,14 @@ def _sort_key_device(col: DeviceColumn, desc: bool, nulls_first: bool):
     return u
 
 
-def lexsort_device(key_cols: List[DeviceColumn],
-                   descending: List[bool] = None,
-                   nulls_first: List[bool] = None,
-                   pad_valid=None):
-    """Stable multi-key argsort on device.  Padding rows (pad_valid False)
-    always sort last.  Returns int32 permutation."""
+def key_passes_device(key_cols: List[DeviceColumn],
+                      descending: List[bool] = None,
+                      nulls_first: List[bool] = None):
+    """Order-preserving uint64 pass encoding of multi-column sort keys:
+    comparing rows lexicographically over the passes (passes[0]
+    dominates) == comparing them under the sort order, with desc /
+    null-placement baked into the encoding.  Shared by the lexsort and
+    the device range partitioner (sampled bounds compare)."""
     import jax.numpy as jnp
 
     n = key_cols[0].data.shape[0]
@@ -262,8 +269,7 @@ def lexsort_device(key_cols: List[DeviceColumn],
         descending = [False] * len(key_cols)
     if nulls_first is None:
         nulls_first = [True] * len(key_cols)
-    order = jnp.arange(n, dtype=jnp.int32)
-    passes = []  # uint64 key passes; passes[0] dominates (applied last)
+    passes = []  # uint64 key passes; passes[0] dominates
     for col, desc, nf in zip(key_cols, descending, nulls_first):
         # null-placement pass dominates this column's value passes
         null_rank = jnp.uint64(0) if nf else jnp.uint64(1)
@@ -285,6 +291,20 @@ def lexsort_device(key_cols: List[DeviceColumn],
                 passes.append(k)
         else:
             passes.append(_sort_key_device(col, desc, nf))
+    return passes
+
+
+def lexsort_device(key_cols: List[DeviceColumn],
+                   descending: List[bool] = None,
+                   nulls_first: List[bool] = None,
+                   pad_valid=None):
+    """Stable multi-key argsort on device.  Padding rows (pad_valid False)
+    always sort last.  Returns int32 permutation."""
+    import jax.numpy as jnp
+
+    n = key_cols[0].data.shape[0] if key_cols else pad_valid.shape[0]
+    order = jnp.arange(n, dtype=jnp.int32)
+    passes = key_passes_device(key_cols, descending, nulls_first)
     if pad_valid is not None:
         passes.insert(0, jnp.where(pad_valid, jnp.uint64(0),
                                    jnp.uint64(2 ** 64 - 1)))

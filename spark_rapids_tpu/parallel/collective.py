@@ -1,0 +1,53 @@
+"""Exchange transports: the pluggable device-to-device data path.
+
+Reference analogue: RapidsShuffleTransport.makeTransport — the transport
+is named by ``spark.rapids.shuffle.transport.class`` and instantiated by
+reflection (RapidsConf.scala:505, RapidsShuffleTransport.scala), with the
+UCX transport (UCXShuffleTransport.scala) as the shipped implementation.
+
+Here the shipped implementation is ``IciCollectiveTransport``: exchanges
+are compiled XLA collectives over the mesh's ICI links (`lax.all_to_all`
+for repartition, `lax.all_gather` for broadcast) — the bounce-buffer /
+tag-matching machinery of UCX collapses into the XLA runtime's transfer
+scheduling.  The class boundary exists for the same reason as the
+reference's: an alternative transport (e.g. a DCN host-relay for
+cross-pod topologies) can be dropped in by conf without touching the
+runner.
+"""
+from __future__ import annotations
+
+import importlib
+
+from ..data.column import DeviceBatch
+from . import exchange as X
+
+
+class IciCollectiveTransport:
+    """All-to-all / all-gather exchange over the mesh axis.  Methods are
+    trace-safe: called inside shard_map per shard."""
+
+    def __init__(self, axis_name: str):
+        self.axis = axis_name
+
+    def exchange(self, batch: DeviceBatch, pids, num_parts: int,
+                 capacity: int = 0) -> DeviceBatch:
+        """Repartition ``batch`` rows by ``pids`` across the mesh
+        (reference: the UCX fetch path, RapidsShuffleClient.scala:452)."""
+        return X.collective_exchange(batch, pids, num_parts, self.axis,
+                                     capacity)
+
+    def replicate(self, batch: DeviceBatch) -> DeviceBatch:
+        """Replicate every shard's rows onto every device (reference:
+        GpuBroadcastExchangeExec.scala:215 build-once-ship-everywhere)."""
+        return X.gather_replicate(batch, self.axis)
+
+
+def make_transport(conf, axis_name: str):
+    """Instantiate the configured transport by reflection (reference:
+    RapidsShuffleTransport.makeTransport)."""
+    from ..config import SHUFFLE_TRANSPORT_CLASS
+
+    path = conf.get(SHUFFLE_TRANSPORT_CLASS)
+    module, _, cls_name = path.rpartition(".")
+    cls = getattr(importlib.import_module(module), cls_name)
+    return cls(axis_name)

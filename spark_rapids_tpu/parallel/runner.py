@@ -44,6 +44,18 @@ from .mesh import DATA_AXIS
 _MAX_JOIN_RETRIES = 4
 
 
+def _max_dest_count(pids, num_parts: int):
+    """Largest per-destination row count — the exchange's true capacity
+    demand (rows with the drop sentinel ``num_parts`` excluded)."""
+    import jax
+    import jax.numpy as jnp
+
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(pids, dtype=jnp.int64), pids,
+        num_segments=num_parts + 1)
+    return counts[:num_parts].max()
+
+
 class DistributedUnsupported(Exception):
     """Raised when a plan node cannot be lowered to the SPMD form."""
 
@@ -79,11 +91,16 @@ class DistributedRunner:
     ``run(plan, ctx)`` returns the collected HostBatch (rows of all
     output partitions concatenated, like ``collect``)."""
 
-    def __init__(self, mesh, min_bucket_rows: int = 128):
+    def __init__(self, mesh, min_bucket_rows: int = 128, transport=None):
+        from .collective import IciCollectiveTransport
+
         self.mesh = mesh
         self.axis = mesh.axis_names[0] if mesh.axis_names else DATA_AXIS
         self.n = int(np.prod([d for d in mesh.devices.shape]))
         self.min_bucket = min_bucket_rows
+        #: pluggable exchange data path (reference: makeTransport
+        #: reflection on spark.rapids.shuffle.transport.class)
+        self.transport = transport or IciCollectiveTransport(self.axis)
 
     # ---------------- stage splitting ---------------------------------
     def _split(self, node, stages: List[_Stage], leaves: List[_LeafRef]):
@@ -132,64 +149,102 @@ class DistributedRunner:
 
     # ---------------- leaf execution ----------------------------------
     def _run_leaf(self, node, ctx) -> DeviceBatch:
-        """Execute a non-distributable subtree locally, split its rows
-        evenly across the mesh, return the stacked sharded batch."""
+        """Execute a non-distributable subtree locally and place it on
+        the mesh.  Partitions are drained CONCURRENTLY (task thread
+        pool) and assigned round-robin to shards, so input decode
+        parallelizes and no global host concat funnels every byte
+        through one array (reference: each task reads its own split,
+        GpuParquetScan.scala:174).  When the source has too few
+        partitions to cover the mesh, rows are re-split evenly."""
         from ..exec.base import TpuExec
         from ..plan.physical import _empty_batch
 
-        host_batches: List[HostBatch] = []
-        if isinstance(node, TpuExec):
-            data = node.execute_columnar(ctx)
-            for pid in range(data.n_partitions):
-                for db in data.iterator(pid):
-                    host_batches.append(device_to_host(db))
-        else:
-            data = node.execute(ctx)
-            for pid in range(data.n_partitions):
-                host_batches.extend(data.iterator(pid))
-        host_batches = [b for b in host_batches if b.num_rows]
-        big = (HostBatch.concat(host_batches) if host_batches
-               else _empty_batch(node.schema))
-        return X.stack_to_mesh(self.mesh, self._stack_host(big))
+        is_dev = isinstance(node, TpuExec)
+        data = node.execute_columnar(ctx) if is_dev else node.execute(ctx)
+        n_parts = data.n_partitions
 
-    def _stack_host(self, big: HostBatch) -> DeviceBatch:
-        """Encode each column ONCE on host and build the stacked
-        [n_shards, bucket, ...] arrays directly (one transfer per
-        column; every shard gets a contiguous row chunk)."""
+        def drain(pid: int) -> List[HostBatch]:
+            if is_dev:
+                return [device_to_host(db) for db in data.iterator(pid)]
+            return list(data.iterator(pid))
+
+        threads = 1
+        if ctx is not None and n_parts > 1:
+            from ..config import TASK_THREADS
+
+            threads = min(ctx.conf.get(TASK_THREADS), n_parts)
+        if threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                per_pid = list(pool.map(drain, range(n_parts)))
+        else:
+            per_pid = [drain(p) for p in range(n_parts)]
+
+        shard_lists: List[List[HostBatch]] = [[] for _ in range(self.n)]
+        for pid, bs in enumerate(per_pid):
+            shard_lists[pid % self.n].extend(
+                b for b in bs if b.num_rows)
+        nonempty = sum(1 for bs in shard_lists if bs)
+        if nonempty <= max(1, self.n // 4):
+            # too few source partitions to cover the mesh: fall back to
+            # an even row split of the (small) concatenated input
+            host = [b for bs in shard_lists for b in bs]
+            big = (HostBatch.concat(host) if host
+                   else _empty_batch(node.schema))
+            n_rows = big.num_rows
+            chunk = -(-n_rows // self.n) if n_rows else 0
+            shards = [big.slice(min(p * chunk, n_rows),
+                                min(p * chunk + chunk, n_rows))
+                      for p in range(self.n)]
+        else:
+            shards = [HostBatch.concat(bs) if bs
+                      else _empty_batch(node.schema)
+                      for bs in shard_lists]
+        return X.stack_to_mesh(self.mesh, self._stack_host(shards))
+
+    def _stack_host(self, shards: List[HostBatch]) -> DeviceBatch:
+        """Build the stacked [n_shards, bucket, ...] arrays from one
+        HostBatch per shard (string widths unified to the global max so
+        every shard's columns are shape-equal)."""
         from .. import types as T
         from ..data import strings as dstrings
 
-        n_rows = big.num_rows
-        chunk = -(-n_rows // self.n) if n_rows else 0
-        bucket = bucket_rows(max(chunk, 1), self.min_bucket)
-        bounds = [(min(p * chunk, n_rows), min(p * chunk + chunk, n_rows))
-                  for p in range(self.n)]
-        num_rows = np.asarray([hi - lo for lo, hi in bounds],
+        bucket = bucket_rows(
+            max(max((b.num_rows for b in shards), default=0), 1),
+            self.min_bucket)
+        num_rows = np.asarray([b.num_rows for b in shards],
                               dtype=np.int32)
+        schema = shards[0].schema
         cols = []
-        for c in big.columns:
-            valid = c.is_valid()
+        for ci, f in enumerate(schema):
             validity = np.zeros((self.n, bucket), dtype=np.bool_)
-            for p, (lo, hi) in enumerate(bounds):
-                validity[p, : hi - lo] = valid[lo:hi]
-            if c.dtype.id is T.TypeId.STRING:
-                bm, ln = dstrings.encode(c.data, c.validity)
-                data = np.zeros((self.n, bucket, bm.shape[1]),
-                                dtype=np.uint8)
+            if f.dtype.id is T.TypeId.STRING:
+                encs = [dstrings.encode(b.columns[ci].data,
+                                        b.columns[ci].validity)
+                        for b in shards]
+                w = max(max((e[0].shape[1] for e in encs), default=1), 1)
+                data = np.zeros((self.n, bucket, w), dtype=np.uint8)
                 lengths = np.zeros((self.n, bucket), dtype=np.int32)
-                for p, (lo, hi) in enumerate(bounds):
-                    data[p, : hi - lo] = bm[lo:hi]
-                    lengths[p, : hi - lo] = ln[lo:hi]
-                cols.append(DeviceColumn(c.dtype, data, validity,
+                for p, (b, (bm, ln)) in enumerate(zip(shards, encs)):
+                    k = b.num_rows
+                    data[p, :k, :bm.shape[1]] = bm
+                    lengths[p, :k] = ln
+                    validity[p, :k] = b.columns[ci].is_valid()
+                cols.append(DeviceColumn(f.dtype, data, validity,
                                          lengths))
             else:
-                data = np.zeros((self.n, bucket), dtype=c.dtype.np_dtype)
-                src = np.where(valid, c.data, np.zeros_like(c.data)) \
-                    if c.validity is not None else c.data
-                for p, (lo, hi) in enumerate(bounds):
-                    data[p, : hi - lo] = src[lo:hi]
-                cols.append(DeviceColumn(c.dtype, data, validity))
-        return DeviceBatch(big.schema, cols, num_rows)
+                data = np.zeros((self.n, bucket), dtype=f.dtype.np_dtype)
+                for p, b in enumerate(shards):
+                    c = b.columns[ci]
+                    k = b.num_rows
+                    valid = c.is_valid()
+                    src = np.where(valid, c.data, np.zeros_like(c.data)) \
+                        if c.validity is not None else c.data
+                    data[p, :k] = src
+                    validity[p, :k] = valid
+                cols.append(DeviceColumn(f.dtype, data, validity))
+        return DeviceBatch(schema, cols, num_rows)
 
     # ---------------- lowering ----------------------------------------
     def _exchange_pids(self, exch, batch: DeviceBatch):
@@ -244,7 +299,7 @@ class DistributedRunner:
         import jax.numpy as jnp
 
         pids = jnp.where(batch.row_mask(), 0, self.n)
-        return X.collective_exchange(batch, pids, self.n, self.axis)
+        return self.transport.exchange(batch, pids, self.n)
 
     def _exchange_by_exprs(self, batch: DeviceBatch, exprs,
                            schema) -> DeviceBatch:
@@ -261,7 +316,82 @@ class DistributedRunner:
         pids = hashing.pmod(hashing.hash_device_batch(cols),
                             self.n).astype(jnp.int32)
         pids = jnp.where(batch.row_mask(), pids, self.n)
-        return X.collective_exchange(batch, pids, self.n, self.axis)
+        return self.transport.exchange(batch, pids, self.n)
+
+    def _range_pids(self, batch: DeviceBatch, sort_keys):
+        """Traced device range partitioning (reference:
+        GpuRangePartitioner.scala:33-104 — sample, bounds, device bound
+        compare).  Per shard: strided sample of the sort-key uint64
+        passes; `all_gather` so every shard sees every sample; global
+        quantile bounds; pid = #bounds the row exceeds
+        lexicographically.
+
+        Correctness needs only the monotone bound compare (row <=
+        bound_i => pid <= i), which holds for ANY bounds — sample
+        quality affects balance, never ordering."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.expression import as_device_column
+        from ..ops.kernels import segment as seg
+
+        padded = batch.padded_rows
+        rm = batch.row_mask()
+        key_cols = [as_device_column(k.expr.eval_tpu(batch), padded)
+                    for k in sort_keys]
+        key_cols = [type(c)(c.dtype, c.data, c.validity & rm, c.lengths)
+                    for c in key_cols]
+        passes = seg.key_passes_device(
+            key_cols,
+            descending=[not k.ascending for k in sort_keys],
+            nulls_first=[k.nulls_first for k in sort_keys])
+        P = jnp.stack(passes)                      # [np, padded]
+
+        S = 64                                     # samples per shard
+        nr = jnp.maximum(batch.num_rows.astype(jnp.int32), 1)
+        idx = (jnp.arange(S, dtype=jnp.int32) * nr) // S
+        samp = P[:, idx]                           # [np, S]
+        samp_valid = jnp.full((S,), True) & (batch.num_rows > 0)
+
+        g = jax.lax.all_gather(samp, self.axis, axis=1, tiled=True)
+        gv = jax.lax.all_gather(samp_valid, self.axis, tiled=True)
+        n_samp = g.shape[1]
+
+        # sort samples (invalid last) exactly like the lexsort
+        order = jnp.arange(n_samp, dtype=jnp.int32)
+        sample_passes = [jnp.where(gv, jnp.uint64(0),
+                                   jnp.uint64(2 ** 64 - 1))] + \
+            [g[i] for i in range(g.shape[0])]
+        for k in reversed(sample_passes):
+            order = order[jnp.argsort(k[order], stable=True)]
+
+        V = gv.sum()
+        bpos = (V * jnp.arange(1, self.n)) // jnp.maximum(self.n, 1)
+        bidx = order[jnp.clip(bpos, 0, n_samp - 1)]
+        bounds = g[:, bidx]                        # [np, n-1]
+
+        eq = jnp.ones((padded, self.n - 1), dtype=jnp.bool_)
+        gt = jnp.zeros((padded, self.n - 1), dtype=jnp.bool_)
+        for j in range(P.shape[0]):
+            pj = P[j][:, None]
+            bj = bounds[j][None, :]
+            gt = gt | (eq & (pj > bj))
+            eq = eq & (pj == bj)
+        pids = gt.sum(axis=1).astype(jnp.int32)
+        return jnp.where(rm, pids, self.n)
+
+    def _capped_exchange(self, child: DeviceBatch, pids, key: str,
+                         aux: Dict, caps: Dict, used_caps: Dict
+                         ) -> DeviceBatch:
+        """Exchange with bounded per-destination capacity + overflow
+        reporting through the stage retry loop."""
+        cap = caps.get(key)
+        if cap is None:
+            cap = bucket_rows(max(2 * child.padded_rows // self.n, 1),
+                              self.min_bucket)
+        used_caps[key] = cap
+        aux[key] = _max_dest_count(pids, self.n)
+        return self.transport.exchange(child, pids, self.n, capacity=cap)
 
     @staticmethod
     def _is_single(part) -> bool:
@@ -327,17 +457,43 @@ class DistributedRunner:
         if isinstance(node, tuple):
             op, *kids = node
             if isinstance(op, TpuShuffleExchangeExec):
+                from ..shuffle.partitioning import SinglePartitioning
+
                 body = self._lower(kids[0], env, aux, caps, used_caps)
                 pids = self._exchange_pids(op, body)
-                return X.collective_exchange(body, pids, self.n,
-                                             self.axis)
+                if isinstance(op.partitioning, SinglePartitioning):
+                    # gather-to-one genuinely needs P x capacity
+                    return self.transport.exchange(body, pids, self.n)
+                # cap the per-destination tile so exchange output stops
+                # inflating padded size P-fold (Weak #3): start at ~2x
+                # the even share, detect overflow, retry bigger
+                return self._capped_exchange(body, pids, f"exch{id(op)}",
+                                             aux, caps, used_caps)
             if isinstance(op, (TpuCoalesceBatchesExec,)):
                 return self._lower(kids[0], env, aux, caps, used_caps)
             if isinstance(op, TpuHashJoinExec):
                 lb = self._lower(kids[0], env, aux, caps, used_caps)
                 rb = self._lower(kids[1], env, aux, caps, used_caps)
                 if isinstance(op, TpuBroadcastHashJoinExec):
-                    rb = X.gather_replicate(rb, self.axis)
+                    rb = self.transport.replicate(rb)
+                else:
+                    # colocation is a correctness invariant, not a
+                    # planner courtesy: verify both sides arrive
+                    # hash-partitioned on the join keys (or single)
+                    lpart = self._source_partitioning(kids[0])
+                    rpart = self._source_partitioning(kids[1])
+                    keys_ok = (
+                        self._hash_keys_match(lpart, op.plan.left_keys)
+                        and self._hash_keys_match(rpart,
+                                                  op.plan.right_keys))
+                    single_ok = (self._is_single(lpart)
+                                 and self._is_single(rpart))
+                    if not (keys_ok or single_ok):
+                        raise DistributedUnsupported(
+                            "shuffled join children are not colocated "
+                            f"on the join keys (left={lpart!r}, "
+                            f"right={rpart!r}) — plan shape would "
+                            "produce wrong rows")
                 key = f"join{id(op)}"
                 cap = caps.get(key)
                 if cap is None:
@@ -369,13 +525,18 @@ class DistributedRunner:
                                      c.lengths) for c in child.columns]
                 return DeviceBatch(child.schema, cols, keep)
             if isinstance(op, TpuSortExec):
-                # a per-shard sort is only globally correct on one
-                # shard; gather unless the producer already funneled
-                # everything to a single partition
+                # distributed sort: range-exchange rows by sampled key
+                # bounds so shard i's rows all order before shard i+1's,
+                # then sort each shard locally — no gather-to-one-shard
+                # bottleneck (reference: GpuRangePartitioning + per-task
+                # sort under Spark's range exchange)
                 child = self._lower(kids[0], env, aux, caps, used_caps)
                 if not self._is_single(
                         self._source_partitioning(kids[0])):
-                    child = self._gather_single(child)
+                    pids = self._range_pids(child, op.keys)
+                    child = self._capped_exchange(
+                        child, pids, f"rexch{id(op)}", aux, caps,
+                        used_caps)
                 return op._compute(child)
             if isinstance(op, TpuWindowExec):
                 child = self._lower(kids[0], env, aux, caps, used_caps)
@@ -404,7 +565,7 @@ class DistributedRunner:
                                 child, op.keys, op.children[0].schema)
                     elif not self._is_single(part):
                         child = self._gather_single(child)
-                return op._compute(child)
+                return op.compute_batch(child)
             if isinstance(op, (B.TpuProjectExec, B.TpuFilterExec,
                                TpuGenerateExec)):
                 child = self._lower(kids[0], env, aux, caps, used_caps)
@@ -424,14 +585,28 @@ class DistributedRunner:
             for k in node[1:]:
                 self._collect_refs(k, out)
 
-    def _collect_join_keys(self, node, out: List[str]):
+    def _collect_aux_keys(self, node, out: List[str]):
+        """Keys of capacity-checked collectives in this stage: joins
+        (static output capacity) and capped exchanges (per-destination
+        tile capacity)."""
+        from ..exec.exchange import TpuShuffleExchangeExec
         from ..exec.joins import TpuHashJoinExec
+        from ..exec.sort import TpuSortExec
+        from ..shuffle.partitioning import SinglePartitioning
 
         if isinstance(node, tuple):
             if isinstance(node[0], TpuHashJoinExec):
                 out.append(f"join{id(node[0])}")
+            if isinstance(node[0], TpuShuffleExchangeExec) and \
+                    not isinstance(node[0].partitioning,
+                                   SinglePartitioning):
+                out.append(f"exch{id(node[0])}")
+            if isinstance(node[0], TpuSortExec) and \
+                    not self._is_single(
+                        self._source_partitioning(node[1])):
+                out.append(f"rexch{id(node[0])}")
             for k in node[1:]:
-                self._collect_join_keys(k, out)
+                self._collect_aux_keys(k, out)
 
     def _run_stage(self, stage: _Stage, env_stacked: Dict,
                    caps: Dict) -> DeviceBatch:
@@ -447,7 +622,7 @@ class DistributedRunner:
         ins = [env_stacked[k] for k in in_keys]
 
         aux_keys: List[str] = []
-        self._collect_join_keys(stage.root, aux_keys)
+        self._collect_aux_keys(stage.root, aux_keys)
         aux_keys = sorted(aux_keys)
 
         for _attempt in range(_MAX_JOIN_RETRIES):
@@ -470,12 +645,12 @@ class DistributedRunner:
             overflow = False
             for k, v in zip(aux_keys, aux_vals):
                 total = int(np.max(np.asarray(v)))
-                if k.startswith("join") and total > used_caps.get(k, 0):
+                if total > used_caps.get(k, 0):
                     caps[k] = bucket_rows(total, self.min_bucket)
                     overflow = True
             if not overflow:
                 return self._retile(out)
-        raise RuntimeError("join capacity retries exhausted")
+        raise RuntimeError("collective capacity retries exhausted")
 
     def _retile(self, stacked: DeviceBatch) -> DeviceBatch:
         """Host-side bucket trim between stages: shapes grow through
@@ -540,7 +715,12 @@ def run_distributed(session, df, mesh=None, n_devices: int = 8
     from ..plan.physical import ExecContext
     from .mesh import make_mesh
 
+    from .collective import make_transport
+    from .mesh import DATA_AXIS as _AX
+
     mesh = mesh or make_mesh(n_devices)
     phys = session.physical_plan(df.plan)
     ctx = ExecContext(session.conf, session)
-    return DistributedRunner(mesh).run(phys, ctx)
+    axis = mesh.axis_names[0] if mesh.axis_names else _AX
+    return DistributedRunner(
+        mesh, transport=make_transport(session.conf, axis)).run(phys, ctx)

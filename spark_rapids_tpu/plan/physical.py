@@ -66,10 +66,40 @@ def _empty_batch(schema: T.Schema) -> HostBatch:
     return HostBatch(schema, [HostColumn.nulls(0, f.dtype) for f in schema])
 
 
-def collect_batches(data: PartitionedData, schema: T.Schema) -> HostBatch:
-    batches = []
-    for pid in range(data.n_partitions):
-        batches.extend(data.iterator(pid))
+def collect_batches(data: PartitionedData, schema: T.Schema,
+                    ctx: "ExecContext" = None) -> HostBatch:
+    """Drain every partition; with a context, partitions run as
+    concurrent tasks on a thread pool — host decode/IO of one task
+    overlaps device compute of another, with the device semaphore as
+    admission control (reference: GpuSemaphore.scala:58-98 + the 2-4
+    tasks/GPU guidance in docs/tuning-guide.md:85-100)."""
+    n = data.n_partitions
+    threads = 1
+    if ctx is not None and n > 1:
+        from ..config import TASK_THREADS
+
+        threads = min(ctx.conf.get(TASK_THREADS), n)
+    if threads <= 1:
+        batches = []
+        for pid in range(n):
+            batches.extend(data.iterator(pid))
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        sem = None
+        if ctx.session is not None and ctx.session.device_manager:
+            sem = ctx.session.device_manager.semaphore
+
+        def run_task(pid: int):
+            try:
+                return list(data.iterator(pid))
+            finally:
+                if sem is not None:
+                    sem.release_all()
+
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            per_pid = list(pool.map(run_task, range(n)))
+        batches = [b for bs in per_pid for b in bs]
     if not batches:
         return _empty_batch(schema)
     return HostBatch.concat(batches)

@@ -39,6 +39,11 @@ class Session:
         self.conf = TpuConf(settings)
         self._executed_plans: List[PhysicalPlan] = []
         self.capture_plans = False
+        from .config import TRACE_ENABLED
+        from .utils import tracing
+
+        if self.conf.get(TRACE_ENABLED):
+            tracing.enable(True)
         if self.conf.is_sql_enabled:
             from .memory.device_manager import DeviceManager
             from .memory.spill import install as install_spill
@@ -115,7 +120,7 @@ class Session:
         phys, ctx = self.prepare_execution(plan)
         data = phys.execute(ctx)
         schema = phys.schema if len(phys.schema) else plan.schema
-        return collect_batches(data, schema)
+        return collect_batches(data, schema, ctx)
 
     def execute_columnar(self, plan: L.LogicalPlan):
         """Zero-copy device export: returns the list of DeviceBatches of

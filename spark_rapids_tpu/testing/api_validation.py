@@ -111,7 +111,54 @@ def validate() -> List[str]:
             if lookup(key) is None:
                 findings.append(f"{kind} {cls.__name__}: enable key "
                                 f"{key} missing from config registry")
+
+    # 4. no vapor keys: every registered entry must be read somewhere
+    findings.extend(_unread_conf_keys())
     return findings
+
+
+def _unread_conf_keys() -> List[str]:
+    """Registered-but-never-read conf keys are documentation fiction:
+    the generated docs promise behavior no code delivers.  An entry
+    counts as read when its config.py variable name (or literal key)
+    appears in package source outside its own definition."""
+    import pathlib
+    import re
+
+    from .. import config as C
+
+    src_root = pathlib.Path(C.__file__).parent
+    blob = []
+    for p in sorted(src_root.rglob("*.py")):
+        if p.name == "config.py":
+            continue
+        blob.append(p.read_text())
+    blob = "\n".join(blob)
+    config_src = pathlib.Path(C.__file__).read_text()
+
+    # auto-derived per-op enable keys are looked up dynamically by the
+    # rule framework (is_operator_enabled) — not scannable by name
+    auto = re.compile(
+        r"^spark\.rapids\.tpu\.sql\.(exec|expr|scan|part|writecmd)\.")
+    names = {e.key: n for n, e in vars(C).items()
+             if isinstance(e, C.ConfEntry)}
+    out = []
+    for key, entry in C._REGISTRY.items():
+        if auto.match(key):
+            continue
+        var = names.get(key)
+        used = False
+        if var is not None:
+            if len(re.findall(rf"\b{var}\b", config_src)) > 1:
+                used = True  # read via a TpuConf property/helper
+            elif re.search(rf"\b{var}\b", blob):
+                used = True
+        if not used and key in blob:
+            used = True
+        if not used:
+            out.append(f"conf {key}: registered but never read "
+                       "(vapor key — delete it or wire it)")
+    return out
 
 
 def main() -> int:  # pragma: no cover - CLI entry

@@ -179,71 +179,51 @@ def test_distributed_tpch_query(qnum):
     _assert_rows_equal(got, exp)
 
 
-def test_two_phase_agg_matches_oracle():
+def test_distributed_range_sort_no_gather():
+    """Distributed sort of raw rows: range-exchange by sampled key
+    bounds (device, traced) then per-shard sort — shard i's rows all
+    order before shard i+1's, so collecting shards in order yields the
+    global order without ever funneling data to one shard."""
     from spark_rapids_tpu import Session
-    from spark_rapids_tpu.parallel import distributed as D
-    from spark_rapids_tpu.plan import functions as F
-    from spark_rapids_tpu.plan import physical as P
-    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu import f
+    from spark_rapids_tpu.parallel.runner import run_distributed
 
-    n_dev = 8
-    mesh = _mesh(n_dev)
-    rng = np.random.RandomState(3)
-    schema = T.Schema([T.Field("k", T.INT64), T.Field("v", T.FLOAT64)])
+    rng = np.random.RandomState(21)
+    n = 4000
+    data = {"v": rng.randint(-10000, 10000, n),
+            "x": (rng.rand(n) * 100).round(6),
+            "s": [f"tag{i % 17}" for i in range(n)]}
 
-    sess = Session(tpu_enabled=True)
-    # build partial/final agg execs through the planner on a probe df
-    k_all = rng.randint(0, 40, 200)
-    v_all = rng.rand(200) * 100
-    df = sess.create_dataframe({"k": k_all, "v": v_all}, schema)
-    agg_df = df.group_by("k").agg(F.sum("v").alias("s"),
-                                  F.count("v").alias("c"),
-                                  F.max("v").alias("m"))
-    phys = sess.physical_plan(agg_df.plan)
-    partial = final = None
+    def q(sess):
+        df = sess.create_dataframe(dict(data))
+        return df.sort(f.col("v"), f.col("x"), f.col("s"))
 
-    def find(p):
-        nonlocal partial, final
-        if isinstance(p, TpuHashAggregateExec):
-            if p.mode == "partial":
-                partial = p
-            elif p.mode == "final":
-                final = p
-        for c in p.children:
-            find(c)
-
-    find(phys)
-    assert partial is not None and final is not None
-
-    # shard input rows round-robin over devices
-    locals_ = []
-    for p in range(n_dev):
-        sel = np.arange(p, 200, n_dev)
-        locals_.append(host_to_device(HostBatch.from_pydict(
-            {"k": k_all[sel], "v": v_all[sel]}, schema),
-            min_bucket_rows=64))
-
-    outs = D.run_two_phase_agg(mesh, partial, final, locals_)
-    rows = []
-    for db in outs:
-        hb = device_to_host(db)
-        rows += hb.to_rows()
-
-    # oracle
-    import collections
-
-    s = collections.defaultdict(float)
-    c = collections.defaultdict(int)
-    m = collections.defaultdict(lambda: -1e30)
-    for k, v in zip(k_all.tolist(), v_all.tolist()):
-        s[k] += v
-        c[k] += 1
-        m[k] = max(m[k], v)
-    expect = sorted((k, s[k], c[k], m[k]) for k in s)
-    got = sorted((r[0], r[1], r[2], r[3]) for r in rows)
-    assert len(got) == len(expect)
-    for g, e in zip(got, expect):
+    sess = Session()
+    got = run_distributed(sess, q(sess), mesh=_mesh(8)).to_rows()
+    exp = q(Session(tpu_enabled=False)).collect()
+    assert len(got) == len(exp)
+    for g, e in zip(got, exp):
         assert g[0] == e[0]
-        assert g[1] == pytest.approx(e[1], rel=1e-9)
+        assert abs(g[1] - e[1]) < 1e-9
         assert g[2] == e[2]
-        assert g[3] == pytest.approx(e[3], rel=1e-12)
+
+
+def test_distributed_range_sort_desc_nulls():
+    from spark_rapids_tpu import Session
+    from spark_rapids_tpu import f
+    from spark_rapids_tpu.parallel.runner import run_distributed
+
+    rng = np.random.RandomState(23)
+    n = 1500
+    vals = [None if i % 11 == 0 else int(v)
+            for i, v in enumerate(rng.randint(-500, 500, n))]
+    data = {"v": vals, "i": list(range(n))}
+
+    def q(sess):
+        df = sess.create_dataframe(dict(data))
+        return df.sort(f.col("v").desc().nulls_first_(), f.col("i"))
+
+    sess = Session()
+    got = run_distributed(sess, q(sess), mesh=_mesh(8)).to_rows()
+    exp = q(Session(tpu_enabled=False)).collect()
+    assert got == exp
