@@ -92,32 +92,77 @@ class TpuShuffleExchangeExec(TpuExec):
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
         store: List[list] = []
-        write_lock = threading.Lock()  # concurrent readers, one writer
+        # Writer election instead of a lock held across the child drain:
+        # the old form (write_lock around the drain) deadlocked under
+        # the device semaphore — the writer blocked inside the child on
+        # a permit while permit-holding readers blocked on the lock
+        # (lock-order inversion, r3 Weak #2).  Now the loser threads
+        # drop their ENTIRE device hold before waiting on the event, so
+        # the writer can always admit the child's device work.
+        elect_lock = threading.Lock()
+        done = threading.Event()
+        state = {"writer": False, "error": None}
+        sem = self._sem(ctx)
         # buf_id -> (id(device_batch), pids): partition ids are computed
         # once per resident batch and reused by all n_out readers; a
         # spill+promote cycle yields a new batch object and recomputes
         pid_cache: dict = {}
         fw = SpillFramework.get()
 
+        def _drain_child():
+            items = []  # (buffer id, round-robin start offset)
+            rr = 0
+            with trace_range("TpuShuffleWrite",
+                             self.metrics[M.TOTAL_TIME]):
+                for pid in range(child.n_partitions):
+                    for b in child.iterator(pid):
+                        n = int(b.num_rows)
+                        if n == 0:
+                            continue
+                        items.append((fw.add_batch(b), rr))
+                        rr = (rr + n) % self.n_out
+            store.append(items)
+
         def materialized():
             """Shuffle write: batches registered as spillable in the
             device store (reference: RapidsCachingWriter keeps map
             output in HBM, spillable under pressure)."""
-            with write_lock:
-                if not store:
-                    items = []  # (buffer id, round-robin start offset)
-                    rr = 0
-                    with trace_range("TpuShuffleWrite",
-                                     self.metrics[M.TOTAL_TIME]):
-                        for pid in range(child.n_partitions):
-                            for b in child.iterator(pid):
-                                n = int(b.num_rows)
-                                if n == 0:
-                                    continue
-                                items.append((fw.add_batch(b), rr))
-                                rr = (rr + n) % self.n_out
-                    store.append(items)
+            if done.is_set():
+                if state["error"] is not None:
+                    raise state["error"]
                 return store[0]
+            with elect_lock:
+                i_write = not state["writer"]
+                state["writer"] = True
+            if i_write:
+                try:
+                    _drain_child()
+                except BaseException as e:  # noqa: BLE001
+                    state["error"] = e
+                    raise
+                finally:
+                    done.set()
+            else:
+                # never wait on another task's progress while holding
+                # the device (reference: GpuSemaphore released during
+                # host-side waits, GpuSemaphore.scala:58-98).  The wait
+                # itself is unbounded ON PURPOSE: a wedged writer fails
+                # through its own semaphore watchdog, which propagates
+                # here via state["error"] — a long legitimate shuffle
+                # write (big scan + first compiles) must not be capped.
+                if sem is not None:
+                    sem.release_all()
+                done.wait()
+                if state["error"] is not None:
+                    raise RuntimeError(
+                        "shuffle write failed in peer task"
+                    ) from state["error"]
+                # re-enter device admission before the reader-side
+                # slice kernels run on the resident batches (nothing
+                # downstream re-acquires for already-on-device data)
+                if sem is not None:
+                    sem.acquire_if_necessary()
+            return store[0]
 
         # drop cached pids the moment their batch is spilled off the
         # device — they are unspillable HBM and would defeat the spill

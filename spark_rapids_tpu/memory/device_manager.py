@@ -42,6 +42,11 @@ class DeviceManager:
         self.devices = jax.devices()
         self.device = self.devices[0]
         self.platform = self.device.platform
+        if self.platform != "cpu":
+            # CPU AOT cache entries are machine-feature sensitive
+            # (XLA warns about SIGILL on mismatch), and the CPU warm
+            # path is already covered by the session's plan cache
+            self._enable_persistent_compile_cache(jax)
         total = self._query_memory()
         self.arena_bytes = int(total * conf.get(DEVICE_MEMORY_FRACTION))
         self.debug = conf.get(DEVICE_MEMORY_DEBUG)
@@ -53,6 +58,28 @@ class DeviceManager:
         if self.debug:
             log.info("DeviceManager: %s, arena=%d bytes",
                      self.device, self.arena_bytes)
+
+    @staticmethod
+    def _enable_persistent_compile_cache(jax) -> None:
+        """Cross-process XLA compile cache (reference intent: cuDF JNI
+        ships precompiled kernels; here compiles are runtime, so cache
+        them on disk — first collect in a fresh process reuses prior
+        compiles of the same program+shape)."""
+        import os
+        import tempfile
+
+        try:
+            if jax.config.jax_compilation_cache_dir:
+                return
+            cache = os.environ.get(
+                "SRT_XLA_CACHE_DIR",
+                os.path.join(tempfile.gettempdir(), "srt_xla_cache"))
+            os.makedirs(cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.3)
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            pass
 
     @classmethod
     def get_or_create(cls, conf: TpuConf) -> "DeviceManager":

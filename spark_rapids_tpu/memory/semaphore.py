@@ -3,23 +3,58 @@
 Reference analogue: GpuSemaphore.scala — limits concurrent tasks holding
 the device (default small), acquired just before device work (e.g. right
 before upload/decode, GpuParquetScan.scala:554) and released while tasks do
-host/IO work, so host-side decode overlaps device compute."""
+host/IO work, so host-side decode overlaps device compute.
+
+Discipline (reference: GpuSemaphore.scala:58-160 — task-scoped acquire +
+a task-completion listener that always releases):
+
+* acquire happens lazily inside device-entry iterators (H2D upload);
+* every task-runner thread releases its full hold in a ``finally``
+  (``collect_batches`` in plan/physical.py, ``_run_leaf`` drain workers
+  in parallel/runner.py);
+* a thread must NEVER block on another thread's progress while holding
+  a permit — call :meth:`release_all` first (see
+  exec/exchange.py ``materialized``);
+* acquire carries a watchdog: a blocked acquire past the deadline raises
+  ``DeviceSemaphoreTimeout`` instead of hanging the process, so a future
+  permit leak fails loudly with a diagnostic."""
 from __future__ import annotations
 
 import threading
 
 
+class DeviceSemaphoreTimeout(RuntimeError):
+    """A device-semaphore acquire blocked past the watchdog deadline —
+    almost always a leaked permit (a task thread that exited without
+    ``release_all``) or a hold-while-blocked cycle."""
+
+
 class DeviceSemaphore:
-    def __init__(self, permits: int):
+    #: watchdog for a single blocked acquire; long enough for any real
+    #: device program (first XLA compile included), short enough that CI
+    #: fails instead of eating its whole budget
+    ACQUIRE_TIMEOUT_SECONDS = 180.0
+
+    def __init__(self, permits: int,
+                 acquire_timeout: float | None = None):
         self.permits = permits
         self._sem = threading.Semaphore(permits)
         self._held = threading.local()
+        self.acquire_timeout = (acquire_timeout
+                                if acquire_timeout is not None
+                                else self.ACQUIRE_TIMEOUT_SECONDS)
 
     def acquire_if_necessary(self) -> None:
         """Idempotent per-thread acquire (a task re-entering device code
         does not double-count — reference GpuSemaphore.acquireIfNecessary)."""
         if getattr(self._held, "count", 0) == 0:
-            self._sem.acquire()
+            if not self._sem.acquire(timeout=self.acquire_timeout):
+                raise DeviceSemaphoreTimeout(
+                    f"device semaphore acquire blocked > "
+                    f"{self.acquire_timeout}s ({self.permits} permits, "
+                    f"thread {threading.current_thread().name}); a task "
+                    "thread likely leaked its permit (missing "
+                    "release_all) or blocked while holding one")
         self._held.count = getattr(self._held, "count", 0) + 1
 
     def release_if_necessary(self) -> None:

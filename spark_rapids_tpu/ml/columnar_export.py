@@ -21,23 +21,27 @@ def export_device_batches(session, plan: L.LogicalPlan) -> List[DeviceBatch]:
     """Execute ``plan`` and return the final columnar stage's device
     batches without downloading them (the reference peels
     GpuColumnarToRowExec off the executed plan the same way)."""
-    phys, ctx = session.prepare_execution(plan)
-    # peel device->host transitions at the root so the final stage stays
-    # on the device (reference: detectAndTagFinalColumnarOutput,
-    # GpuTransitionOverrides.scala:256-261)
-    while isinstance(phys, DeviceToHostExec):
-        phys = phys.children[0]
-    data = phys.execute_columnar(ctx) if hasattr(phys, "execute_columnar") \
-        else phys.execute(ctx)
-    out: List[DeviceBatch] = []
-    for pid in range(data.n_partitions):
-        for b in data.iterator(pid):
-            if isinstance(b, HostBatch):  # plan fell back to the host
-                from ..data.column import host_to_device
+    root, ctx = session.prepare_execution(plan)
+    try:
+        # peel device->host transitions at the root so the final stage
+        # stays on the device (reference: detectAndTagFinalColumnarOutput,
+        # GpuTransitionOverrides.scala:256-261)
+        phys = root
+        while isinstance(phys, DeviceToHostExec):
+            phys = phys.children[0]
+        data = phys.execute_columnar(ctx) \
+            if hasattr(phys, "execute_columnar") else phys.execute(ctx)
+        out: List[DeviceBatch] = []
+        for pid in range(data.n_partitions):
+            for b in data.iterator(pid):
+                if isinstance(b, HostBatch):  # plan fell back to the host
+                    from ..data.column import host_to_device
 
-                b = host_to_device(b)
-            out.append(b)
-    return out
+                    b = host_to_device(b)
+                out.append(b)
+        return out
+    finally:
+        root._exec_lock.release()
 
 
 def to_feature_matrix(batches: List[DeviceBatch], columns=None):

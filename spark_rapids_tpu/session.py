@@ -39,6 +39,13 @@ class Session:
         self.conf = TpuConf(settings)
         self._executed_plans: List[PhysicalPlan] = []
         self.capture_plans = False
+        # logical-plan -> physical-plan cache: repeated collect() of the
+        # same DataFrame reuses the exec instances and with them every
+        # per-exec jit cache (without this, each collect re-traced and
+        # re-compiled ~5 XLA programs — measured ~8s/collect on CPU)
+        import weakref
+
+        self._plan_cache = weakref.WeakKeyDictionary()
         from .config import TRACE_ENABLED
         from .utils import tracing
 
@@ -110,17 +117,41 @@ class Session:
 
     def prepare_execution(self, plan: L.LogicalPlan):
         """Plan + capture + context — the shared front half of execute
-        paths (incl. the ML columnar export)."""
-        phys = self.physical_plan(plan)
+        paths (incl. the ML columnar export).
+
+        Cached exec instances are handed out to ONE execution at a
+        time (``_exec_lock``, non-blocking): execs carry per-execution
+        state (metrics registries), so a concurrent collect of the same
+        DataFrame gets a freshly planned tree instead of sharing."""
+        import threading
+
+        try:
+            phys = self._plan_cache.get(plan)
+        except TypeError:  # unhashable/unweakref-able plan
+            phys = None
+        if phys is not None and not phys._exec_lock.acquire(
+                blocking=False):
+            phys = None  # cached tree busy in another thread
+        if phys is None:
+            phys = self.physical_plan(plan)
+            phys._exec_lock = threading.Lock()
+            phys._exec_lock.acquire()
+            try:
+                self._plan_cache[plan] = phys
+            except TypeError:
+                pass
         if self.capture_plans:
             self._executed_plans.append(phys)
         return phys, ExecContext(self.conf, self)
 
     def execute(self, plan: L.LogicalPlan) -> HostBatch:
         phys, ctx = self.prepare_execution(plan)
-        data = phys.execute(ctx)
-        schema = phys.schema if len(phys.schema) else plan.schema
-        return collect_batches(data, schema, ctx)
+        try:
+            data = phys.execute(ctx)
+            schema = phys.schema if len(phys.schema) else plan.schema
+            return collect_batches(data, schema, ctx)
+        finally:
+            phys._exec_lock.release()
 
     def execute_columnar(self, plan: L.LogicalPlan):
         """Zero-copy device export: returns the list of DeviceBatches of

@@ -29,7 +29,27 @@ try:  # deregister the remote-TPU plugin so backends() never dials it
 except Exception:  # noqa: BLE001
     pass
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+# A hang must fail, not eat CI (r3 shipped with the full suite unable to
+# complete).  Two layers: (1) the device-semaphore watchdog raises after
+# a short wait in tests, so permit leaks become tracebacks; (2) a
+# per-test faulthandler deadline dumps all thread stacks and hard-exits
+# if anything else wedges.
+from spark_rapids_tpu.memory.semaphore import DeviceSemaphore  # noqa: E402
+
+DeviceSemaphore.ACQUIRE_TIMEOUT_SECONDS = 60.0
+
+_PER_TEST_TIMEOUT = float(os.environ.get("SRT_TEST_TIMEOUT", "600"))
+
+
+@pytest.fixture(autouse=True)
+def _hang_watchdog():
+    faulthandler.dump_traceback_later(_PER_TEST_TIMEOUT, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture()

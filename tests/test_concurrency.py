@@ -19,6 +19,20 @@ def _norm(rows):
     return sorted(rows, key=repr)
 
 
+def _assert_rows_close(got, exp):
+    """Sorted row equality with float tolerance (device partial sums
+    reduce in a different order than the host oracle)."""
+    got, exp = _norm(got), _norm(exp)
+    assert len(got) == len(exp), (len(got), len(exp))
+    for g, e in zip(got, exp):
+        assert len(g) == len(e)
+        for a, b in zip(g, e):
+            if isinstance(a, float) and b is not None:
+                assert a == pytest.approx(b, rel=1e-9, abs=1e-9), (g, e)
+            else:
+                assert a == b, (g, e)
+
+
 @pytest.mark.parametrize("threads", [1, 4])
 def test_concurrent_collect_matches_sequential(threads):
     rng = np.random.RandomState(5)
@@ -83,6 +97,113 @@ def test_semaphore_bounds_concurrency():
         t.join(timeout=10)
     assert max(peak) <= 2
     assert len(peak) == 6  # every task eventually admitted
+
+
+def test_acquire_watchdog_raises_instead_of_hanging():
+    from spark_rapids_tpu.memory.semaphore import DeviceSemaphoreTimeout
+
+    sem = DeviceSemaphore(1, acquire_timeout=0.2)
+    sem.acquire_if_necessary()
+
+    err = []
+
+    def starved():
+        try:
+            sem.acquire_if_necessary()
+        except DeviceSemaphoreTimeout as e:
+            err.append(e)
+
+    t = threading.Thread(target=starved)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert err, "blocked acquire must raise after the watchdog deadline"
+    sem.release_all()
+
+
+def _two_leaf_join_query(sess, orders, cust):
+    from spark_rapids_tpu.plan import functions as F
+
+    o = sess.create_dataframe(dict(orders))
+    c = sess.create_dataframe(dict(cust))
+    j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+    return j.group_by("c_nation").agg(F.sum("o_total").alias("rev"),
+                                      F.count("o_total").alias("n"))
+
+
+def _deadlock_conf():
+    # the r3 deadlock shape: more task threads than device permits
+    return {"spark.rapids.tpu.sql.taskThreads": 8,
+            "spark.rapids.tpu.sql.concurrentTpuTasks": 2,
+            "spark.rapids.tpu.sql.broadcastSizeThreshold": 0}
+
+
+def _join_inputs():
+    rng = np.random.RandomState(11)
+    orders = {"o_custkey": rng.randint(0, 50, 400),
+              "o_total": rng.rand(400) * 1000}
+    cust = {"c_custkey": np.arange(50),
+            "c_nation": rng.randint(0, 5, 50)}
+    return orders, cust
+
+
+def test_distributed_two_leaf_join_does_not_leak_permits():
+    """r3 deadlock #1 regression: a >=2-leaf distributed plan with
+    taskThreads > concurrentTpuTasks — the drain workers of the first
+    leaf used to consume every permit forever (runner._run_leaf had no
+    task-completion release)."""
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel.runner import run_distributed
+
+    orders, cust = _join_inputs()
+    sess = srt.Session(_deadlock_conf())
+    got = run_distributed(sess, _two_leaf_join_query(
+        sess, orders, cust), mesh=make_mesh(8)).to_rows()
+
+    ref = srt.Session(tpu_enabled=False)
+    want = _two_leaf_join_query(ref, orders, cust).collect()
+    _assert_rows_close(got, want)
+
+
+def test_two_consecutive_distributed_runs_same_process():
+    """r3 deadlock #1 regression (second shape): the DeviceManager is a
+    process singleton, so permits leaked by run #1 used to wedge run #2
+    even for single-leaf plans."""
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel.runner import run_distributed
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.RandomState(3)
+    data = {"k": rng.randint(0, 20, 300), "v": rng.rand(300) * 100}
+
+    def q(sess):
+        df = sess.create_dataframe(dict(data), n_partitions=6)
+        return df.group_by("k").agg(F.sum("v").alias("s"))
+
+    sess = srt.Session(_deadlock_conf())
+    mesh = make_mesh(8)
+    first = _norm(run_distributed(sess, q(sess), mesh=mesh).to_rows())
+    second = _norm(run_distributed(sess, q(sess), mesh=mesh).to_rows())
+    assert first == second
+
+    ref = srt.Session(tpu_enabled=False)
+    want = _norm(q(ref).collect())
+    assert first == want
+
+
+def test_local_shuffled_join_under_permit_starvation():
+    """r3 deadlock #2 regression: exchange materialization used to hold
+    its write lock across the child drain (which blocks on a permit)
+    while permit-holding reader tasks blocked on the lock.  8 task
+    threads over 2 permits through a two-exchange shuffled join is
+    exactly the bench q3/q5/q16 shape that timed out."""
+    orders, cust = _join_inputs()
+    sess = srt.Session(_deadlock_conf())
+    got = _two_leaf_join_query(sess, orders, cust).collect()
+
+    ref = srt.Session(tpu_enabled=False)
+    want = _two_leaf_join_query(ref, orders, cust).collect()
+    _assert_rows_close(got, want)
 
 
 def test_release_all_drops_reentrant_hold():
