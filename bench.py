@@ -64,27 +64,13 @@ def _emit(obj) -> None:
 
 
 def _probe_backend():
-    """Platform of the default jax backend, determined in a subprocess
-    bounded by PROBE_TIMEOUT_S — never dials the (possibly wedged) TPU
-    tunnel from this process before knowing it answers.  Returns e.g.
-    'tpu'/'axon'/'cpu', or None on timeout/failure."""
-    import subprocess
+    """Platform of the default jax backend via the shared time-bounded
+    subprocess probe (single implementation: __graft_entry__), or None
+    on timeout/failure."""
+    import __graft_entry__ as ge
 
-    code = ("import jax; d = jax.devices(); "
-            "print('SRT_PROBE', d[0].platform, len(d))")
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True,
-            text=True, timeout=PROBE_TIMEOUT_S,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-    except (subprocess.TimeoutExpired, OSError):
-        return None
-    if proc.returncode != 0:
-        return None
-    for line in proc.stdout.splitlines():
-        if line.startswith("SRT_PROBE "):
-            return line.split()[1]
-    return None
+    probed = ge.probe_backend(timeout=PROBE_TIMEOUT_S)
+    return probed[0] if probed else None
 
 
 def _force_local_cpu() -> None:
@@ -342,6 +328,11 @@ def main():
         qbytes = sum(sizes[t] for t in tables)
         df = tpch.QUERIES[qn](t_tpu)
         tpu_s, noise = _best(lambda: df.collect(), deadline=deadline)
+        # evidence FIRST: the device number lands before any
+        # (unbounded) CPU-side baseline run can blow the budget
+        _emit({"progress": f"q{qn}.tpu", "tpu_s": round(tpu_s, 4),
+               "gb_per_s": round(qbytes / tpu_s / 1e9, 3),
+               "elapsed_s": round(time.perf_counter() - _T0, 1)})
 
         # CPU side: pandas always; the (slow, row-at-a-time) host
         # oracle only while budget remains

@@ -15,9 +15,39 @@ Host-side strings are ``object`` ndarrays of python ``str``.
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
+
+# pyarrow's object-ndarray converters are not reliably thread-safe in
+# this environment when task threads convert while XLA's own thread pool
+# is busy (observed hard SIGSEGV in pa.array under the concurrent
+# collect path); one lock serializes the C conversion — still ~40x the
+# python loop — and costs nothing in the single-thread case
+_PA_LOCK = threading.Lock()
+
+
+def _pa():
+    """pyarrow with its memory pool forced to the system allocator —
+    arrow's bundled mimalloc pool segfaults under this image's
+    concurrent XLA-CPU + task-thread workload (observed repeatedly in
+    pa.array during multithreaded collects; system pool is stable)."""
+    import pyarrow as pa
+
+    if not getattr(_pa, "_pool_set", False):
+        try:
+            pa.set_memory_pool(pa.system_memory_pool())
+        except Exception:  # noqa: BLE001
+            pass
+        _pa._pool_set = True
+    return pa
+
+
+import os as _os
+
+_FORCE_SLOW_ENCODE = _os.environ.get("SRT_SLOW_ENCODE") == "1"
+_FORCE_SLOW_DECODE = _os.environ.get("SRT_SLOW_DECODE") == "1"
 
 
 def _encode_slow(values, validity, max_len):
@@ -52,28 +82,28 @@ def encode(values: np.ndarray, validity: Optional[np.ndarray],
     r3 bench (≈40% of a q1 collect).  Falls back to the python loop for
     mixed/bytes inputs."""
     n = len(values)
-    if n == 0:
+    if n == 0 or _FORCE_SLOW_ENCODE:
         return _encode_slow(values, validity, max_len)
     try:
-        import pyarrow as pa
+        pa = _pa()
     except ImportError:
         return _encode_slow(values, validity, max_len)
     try:
         vals = np.asarray(values, dtype=object)
         if validity is not None:
             vals = np.where(np.asarray(validity, dtype=bool), vals, None)
-        arr = pa.array(vals, type=pa.string())
-        bufs = arr.buffers()
-        offsets = np.frombuffer(bufs[1], dtype=np.int32, count=n + 1)
-        nbytes = int(offsets[-1])
-        data = (np.frombuffer(bufs[2], dtype=np.uint8, count=nbytes)
-                if bufs[2] is not None and nbytes else
-                np.empty(0, dtype=np.uint8))
+        with _PA_LOCK:
+            arr = pa.array(vals, type=pa.string())
+            bufs = arr.buffers()
+            offsets = np.array(
+                np.frombuffer(bufs[1], dtype=np.int32, count=n + 1))
+            nbytes = int(offsets[-1])
+            data = (np.array(np.frombuffer(bufs[2], dtype=np.uint8,
+                                           count=nbytes))
+                    if bufs[2] is not None and nbytes else
+                    np.empty(0, dtype=np.uint8))
+        # null rows have equal offsets, so their lengths are already 0
         lengths = np.diff(offsets).astype(np.int32)
-        if arr.null_count:
-            # arrow leaves offsets equal for nulls, so lengths are
-            # already 0 — nothing to mask
-            pass
     except Exception:  # noqa: BLE001 — any arrow failure: exact slow path
         return _encode_slow(values, validity, max_len)
     ml = int(lengths.max()) if n else 0
@@ -95,24 +125,35 @@ def decode(byte_mat: np.ndarray, lengths: np.ndarray,
     n = byte_mat.shape[0]
     lengths = np.asarray(lengths)
     try:
-        import pyarrow as pa
+        if _FORCE_SLOW_DECODE:
+            raise RuntimeError("forced slow decode")
+        pa = _pa()
 
         w = byte_mat.shape[1] if byte_mat.ndim == 2 else 0
-        ln = np.minimum(lengths.astype(np.int64), w)
+        # clamp HARD: invalid/padding lanes carry arbitrary gathered
+        # lengths (negative or > width); unclamped they make the cumsum
+        # offsets non-monotonic and from_buffers then reads out of
+        # bounds — corrupt str objects that crash far away (observed
+        # SIGSEGV in a later pa.array over re-encoded output)
+        ln = np.clip(lengths.astype(np.int64), 0, w)
+        if validity is not None:
+            ln = np.where(np.asarray(validity, dtype=bool), ln, 0)
         mask = np.arange(w, dtype=np.int64) < ln[:, None]
         flat = np.ascontiguousarray(byte_mat[mask])
         offsets = np.zeros(n + 1, dtype=np.int32)
         np.cumsum(ln, out=offsets[1:])
-        arr = pa.StringArray.from_buffers(
-            n, pa.py_buffer(offsets.tobytes()),
-            pa.py_buffer(flat.tobytes()))
-        out = arr.to_numpy(zero_copy_only=False)
+        with _PA_LOCK:
+            arr = pa.StringArray.from_buffers(
+                n, pa.py_buffer(offsets.tobytes()),
+                pa.py_buffer(flat.tobytes()))
+            out = arr.to_numpy(zero_copy_only=False)
         if out.dtype != object:
             out = out.astype(object)
     except Exception:  # noqa: BLE001 — e.g. invalid utf-8: exact slow path
+        w = byte_mat.shape[1] if byte_mat.ndim == 2 else 0
         out = np.empty(n, dtype=object)
         for i in range(n):
-            k = int(lengths[i])
+            k = max(0, min(int(lengths[i]), w))
             out[i] = bytes(byte_mat[i, :k]).decode("utf-8",
                                                    errors="replace")
     if validity is not None:

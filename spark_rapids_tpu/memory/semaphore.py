@@ -37,31 +37,53 @@ class DeviceSemaphore:
 
     def __init__(self, permits: int,
                  acquire_timeout: float | None = None):
+        import time
+
         self.permits = permits
         self._sem = threading.Semaphore(permits)
         self._held = threading.local()
         self.acquire_timeout = (acquire_timeout
                                 if acquire_timeout is not None
                                 else self.ACQUIRE_TIMEOUT_SECONDS)
+        #: monotonic stamp of the most recent release — the watchdog
+        #: measures STALL (no release anywhere), not queueing time, so
+        #: a long fair queue behind slow-but-progressing tasks never
+        #: trips it
+        self._last_release = time.monotonic()
 
     def acquire_if_necessary(self) -> None:
         """Idempotent per-thread acquire (a task re-entering device code
-        does not double-count — reference GpuSemaphore.acquireIfNecessary)."""
+        does not double-count — reference GpuSemaphore.acquireIfNecessary).
+
+        Raises :class:`DeviceSemaphoreTimeout` only when NO permit has
+        been released anywhere for ``acquire_timeout`` seconds while
+        this thread waited — i.e. the pool has genuinely stopped making
+        progress (leaked permit / hold-while-blocked cycle)."""
+        import time
+
         if getattr(self._held, "count", 0) == 0:
-            if not self._sem.acquire(timeout=self.acquire_timeout):
-                raise DeviceSemaphoreTimeout(
-                    f"device semaphore acquire blocked > "
-                    f"{self.acquire_timeout}s ({self.permits} permits, "
-                    f"thread {threading.current_thread().name}); a task "
-                    "thread likely leaked its permit (missing "
-                    "release_all) or blocked while holding one")
+            start = time.monotonic()
+            while not self._sem.acquire(
+                    timeout=min(self.acquire_timeout / 4, 10.0)):
+                progress = max(self._last_release, start)
+                if time.monotonic() - progress > self.acquire_timeout:
+                    raise DeviceSemaphoreTimeout(
+                        f"device semaphore made no progress for > "
+                        f"{self.acquire_timeout}s ({self.permits} "
+                        f"permits, thread "
+                        f"{threading.current_thread().name}); a task "
+                        "thread likely leaked its permit (missing "
+                        "release_all) or blocked while holding one")
         self._held.count = getattr(self._held, "count", 0) + 1
 
     def release_if_necessary(self) -> None:
+        import time
+
         count = getattr(self._held, "count", 0)
         if count > 0:
             self._held.count = count - 1
             if self._held.count == 0:
+                self._last_release = time.monotonic()
                 self._sem.release()
 
     def release_all(self) -> None:
@@ -69,9 +91,12 @@ class DeviceSemaphore:
         (reference: GpuSemaphore's task-completion listener,
         GpuSemaphore.scala:101-160).  The underlying permit is held once
         per thread regardless of the reentrancy count."""
+        import time
+
         count = getattr(self._held, "count", 0)
         if count > 0:
             self._held.count = 0
+            self._last_release = time.monotonic()
             self._sem.release()
 
     def __enter__(self):

@@ -1,0 +1,173 @@
+"""Multi-process / multi-host distributed execution.
+
+Reference analogue: the executor model of the RAPIDS shuffle — one JVM
+per node, each owning one GPU, with shuffle data moving BETWEEN
+processes over UCX (Plugin.scala:219-247 executor bootstrap,
+UCX.scala:54-86 worker/endpoint plumbing, RapidsShuffleClient.scala:452
+fetch protocol).  The TPU-native form is jax's multi-controller SPMD:
+
+    * every process calls ``jax.distributed.initialize`` (the TCP
+      handshake the reference does over its management port,
+      UCXConnection.scala:354)
+    * the global mesh spans every process's local devices; the SAME
+      stage program runs on every controller
+    * exchanges stay the SAME compiled ``all_to_all`` — XLA routes
+      lanes over ICI within a host and DCN across hosts; the entire
+      client/server/bounce-buffer machinery of the reference collapses
+      into the runtime (SURVEY §5 "Distributed communication backend")
+
+Host-side control flow (stage loop, capacity retries) is replicated on
+every controller, so every decision must derive from replicated values
+— the runner pmax-replicates capacity aux outputs for exactly this
+reason (see DistributedRunner._run_stage).
+
+Process-local leaf execution: non-distributable subtrees (scans, host
+fallbacks) are executed by EVERY process — deterministically identical
+— and each process materializes only its addressable shards
+(``jax.make_array_from_callback``).  This mirrors Spark recomputing a
+partition's lineage on whichever executor owns the task, without a
+driver shipping bytes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..data.column import DeviceBatch, HostBatch, device_to_host
+from . import exchange as X
+from .runner import DistributedRunner
+
+
+def init_multiprocess(coordinator: str, num_processes: int,
+                      process_id: int,
+                      local_cpu_devices: Optional[int] = None):
+    """Join the multi-controller job and return the global mesh.
+
+    ``local_cpu_devices``: for tests/CI — force this process onto the
+    local CPU backend with that many virtual devices BEFORE the backend
+    initializes (the 2-process CPU fixture the reference never had for
+    its UCX path, SURVEY §4 "TPU-build implication")."""
+    import os
+
+    if local_cpu_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                        f"{local_cpu_devices}").strip()
+    import jax
+
+    if local_cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge as _xb
+
+            _xb._backend_factories.pop("axon", None)
+        except Exception:  # noqa: BLE001
+            pass
+    jax.distributed.initialize(coordinator, num_processes=num_processes,
+                               process_id=process_id)
+    # single-device work (leaf uploads) must land on a device THIS
+    # process owns, never a peer's (the executor-local GPU rule,
+    # GpuDeviceManager.scala:98-112)
+    jax.config.update("jax_default_device", jax.local_devices()[0])
+    from jax.sharding import Mesh
+
+    from .mesh import DATA_AXIS
+
+    devs = np.array(sorted(jax.devices(), key=lambda d: d.id))
+    return Mesh(devs, (DATA_AXIS,))
+
+
+class MultiProcessRunner(DistributedRunner):
+    """DistributedRunner over a mesh that spans OS processes/hosts.
+
+    Differences from the single-controller base:
+      * leaf placement constructs global arrays shard-by-shard so each
+        process only touches devices it owns;
+      * inter-stage retiling reads row counts through a replicated
+        reduction (a sharded array is not host-readable on every
+        controller);
+      * the final collect gathers every process's shards
+        (``multihost_utils.process_allgather`` — the read side of the
+        reference's fetch protocol, RapidsShuffleIterator.scala:45)."""
+
+    def _place(self, stacked: DeviceBatch) -> DeviceBatch:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+
+        def put(arr):
+            arr = np.asarray(arr)
+            sh = NamedSharding(mesh, P(*([self.axis]
+                                         + [None] * (arr.ndim - 1))))
+            return jax.make_array_from_callback(
+                arr.shape, sh, lambda idx, a=arr: a[idx])
+
+        cols = []
+        from ..data.column import DeviceColumn
+
+        for c in stacked.columns:
+            cols.append(DeviceColumn(
+                c.dtype, put(c.data), put(c.validity),
+                put(c.lengths) if c.lengths is not None else None))
+        return DeviceBatch(stacked.schema, cols, put(stacked.num_rows))
+
+    def _retile(self, stacked: DeviceBatch) -> DeviceBatch:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..data.column import bucket_rows as _bucket
+
+        mx = jax.jit(lambda r: r.max(),
+                     out_shardings=NamedSharding(self.mesh, P()))(
+            stacked.num_rows)
+        need = _bucket(max(int(np.asarray(mx)), 1), self.min_bucket)
+        if need >= stacked.padded_rows:
+            return stacked
+        from ..data.column import DeviceColumn
+
+        sharding = NamedSharding(self.mesh, P(self.axis))
+
+        @jax.jit
+        def trim(b):
+            cols = [DeviceColumn(
+                c.dtype, c.data[:, :need], c.validity[:, :need],
+                c.lengths[:, :need] if c.lengths is not None else None)
+                for c in b.columns]
+            return DeviceBatch(b.schema, cols, b.num_rows)
+
+        out = trim(stacked)
+        return jax.device_put(out, sharding)
+
+    def _collect_output(self, out: DeviceBatch, stages) -> HostBatch:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(out, tiled=True)
+        # gathered leaves are full global numpy arrays [n, ...]
+        parts = X.unstack_partitions(gathered)
+        host = [device_to_host(p) for p in parts]
+        host = [h for h in host if h.num_rows]
+        if not host:
+            from ..plan.physical import _empty_batch
+
+            return _empty_batch(self._schema_of(stages[-1].root))
+        return HostBatch.concat(host)
+
+
+def run_distributed_mp(session, df, mesh) -> HostBatch:
+    """Execute ``df`` SPMD across every controller process of ``mesh``.
+    Must be called by ALL processes with an identically-built plan;
+    returns the full result on every process."""
+    from ..plan.physical import ExecContext
+    from .collective import make_transport
+    from .mesh import DATA_AXIS as _AX
+
+    phys = session.physical_plan(df.plan)
+    ctx = ExecContext(session.conf, session)
+    axis = mesh.axis_names[0] if mesh.axis_names else _AX
+    return MultiProcessRunner(
+        mesh, transport=make_transport(session.conf, axis)).run(phys, ctx)

@@ -216,7 +216,12 @@ class DistributedRunner:
             shards = [HostBatch.concat(bs) if bs
                       else _empty_batch(node.schema)
                       for bs in shard_lists]
-        return X.stack_to_mesh(self.mesh, self._stack_host(shards))
+        return self._place(self._stack_host(shards))
+
+    def _place(self, stacked: DeviceBatch) -> DeviceBatch:
+        """Put a host-stacked [n, ...] batch onto the mesh (overridden
+        by the multi-process runner to place only addressable shards)."""
+        return X.stack_to_mesh(self.mesh, stacked)
 
     def _stack_host(self, shards: List[HostBatch]) -> DeviceBatch:
         """Build the stacked [n_shards, bucket, ...] arrays from one
@@ -648,18 +653,23 @@ class DistributedRunner:
                        for k, b in zip(in_keys, stacked)}
                 aux: Dict = {}
                 out = self._lower(stage.root, env, aux, caps, used_caps)
+                # aux (capacity demands) replicated via pmax so EVERY
+                # controller process reads the same overflow verdict and
+                # takes the same retry path (multi-process SPMD needs
+                # identical host control flow on all controllers)
                 return (X.unsqueeze_leading(out),
-                        tuple(aux[k].reshape((1,)) for k in aux_keys))
+                        tuple(jax.lax.pmax(aux[k].reshape(()), self.axis)
+                              for k in aux_keys))
 
             spec = P(self.axis)
             spmd = jax.jit(shard_map(
                 per_shard, mesh=self.mesh,
                 in_specs=(spec,) * len(ins),
-                out_specs=(spec, (spec,) * len(aux_keys))))
+                out_specs=(spec, (P(),) * len(aux_keys))))
             out, aux_vals = spmd(*ins)
             overflow = False
             for k, v in zip(aux_keys, aux_vals):
-                total = int(np.max(np.asarray(v)))
+                total = int(np.asarray(v))
                 if total > used_caps.get(k, 0):
                     caps[k] = bucket_rows(total, self.min_bucket)
                     overflow = True
@@ -706,6 +716,12 @@ class DistributedRunner:
         for stage in stages:
             out = self._run_stage(stage, env_stacked, caps)
             env_stacked[f"stage{stage.sid}"] = out
+        return self._collect_output(out, stages)
+
+    def _collect_output(self, out: DeviceBatch, stages) -> HostBatch:
+        """Download the final stacked stage output to one HostBatch
+        (overridden by the multi-process runner, which must first
+        gather non-addressable shards)."""
         parts = X.unstack_partitions(out)
         host = [device_to_host(p) for p in parts]
         host = [h for h in host if h.num_rows]

@@ -1,0 +1,56 @@
+"""Worker entry for the 2-process distributed test (NOT a pytest file).
+
+Each OS process joins the multi-controller job, builds the SAME seeded
+TPC-H-shaped join+agg plan, executes it through MultiProcessRunner over
+the global mesh, and checks the gathered result against the local host
+oracle.  Run by tests/test_multiprocess.py as:
+
+    python tests/mp_worker_script.py <coordinator> <nprocs> <pid>
+"""
+import sys
+
+
+def main():
+    coordinator, nprocs, pid = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]))
+
+    from spark_rapids_tpu.parallel.multiprocess import (
+        init_multiprocess, run_distributed_mp)
+
+    mesh = init_multiprocess(coordinator, nprocs, pid,
+                             local_cpu_devices=4)
+
+    import numpy as np
+
+    from spark_rapids_tpu import Session
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.RandomState(123)
+    orders = {"o_custkey": rng.randint(0, 60, 500),
+              "o_total": (rng.rand(500) * 1000).round(6)}
+    cust = {"c_custkey": np.arange(60),
+            "c_nation": rng.randint(0, 6, 60)}
+
+    def q(sess):
+        o = sess.create_dataframe(dict(orders))
+        c = sess.create_dataframe(dict(cust))
+        j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+        return j.group_by("c_nation").agg(
+            F.sum("o_total").alias("rev"), F.count("o_total").alias("n"))
+
+    # force the shuffled-join path so the cross-process all_to_all is
+    # what actually moves the data
+    sess = Session({"spark.rapids.tpu.sql.broadcastSizeThreshold": 0})
+    got = sorted(run_distributed_mp(sess, q(sess), mesh).to_rows())
+
+    cpu = Session(tpu_enabled=False)
+    want = sorted(q(cpu).collect())
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[2] == w[2], (g, w)
+        assert abs(g[1] - w[1]) < 1e-6 * max(1.0, abs(w[1])), (g, w)
+    print(f"MP RESULT OK pid={pid} rows={len(got)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
