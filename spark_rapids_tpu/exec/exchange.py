@@ -12,25 +12,97 @@ device-store caching path (RapidsCachingWriter,
 RapidsShuffleInternalManager.scala:90-138); the mesh-collective
 exchange for true multi-chip runs lives in parallel/exchange.py.
 
-Partitionings: hash / single / round-robin run on device; range falls
-back to the host exchange (its reservoir-sample bounds are a host-side
-prepare step — GpuRangePartitioner.scala does the same sampling on the
-driver).
+Partitionings: hash / single / round-robin / range all run on device.
+Range mirrors the reference's split of work (GpuRangePartitioner.scala:
+33-104 — driver-side sampled bounds, device-side bound compare): key
+samples are taken on device during the shuffle write, the quantile
+bounds are picked on host from the tiny sample, and row placement is a
+compiled lexicographic bound-compare over order-preserving uint64 key
+passes.  String keys are coarsened to a fixed byte prefix for
+placement only — prefix compare is a monotone coarsening of the true
+order, so per-partition sort + in-order concat still yields a total
+order (balance, never correctness, depends on the prefix).
 """
 from __future__ import annotations
 
 from typing import List
 
-from ..data.column import DeviceBatch
+import numpy as np
+
+from ..data.column import DeviceBatch, DeviceColumn
 from ..ops.expression import as_device_column
+from ..ops.kernels import segment as seg
 from ..ops.kernels.gather import compact
-from ..shuffle.partitioning import (HashPartitioning,
+from ..shuffle.partitioning import (HashPartitioning, RangePartitioning,
                                     RoundRobinPartitioning,
                                     SinglePartitioning)
 from ..utils import hashing
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
 from .base import DevicePartitionedData, TpuExec
+
+#: string keys are truncated to this byte prefix for range PLACEMENT
+#: (not for the sort itself) — 4 uint64 passes per string key
+RANGE_PREFIX_BYTES = 32
+
+#: per-batch device key samples taken for the range bounds
+RANGE_SAMPLES_PER_BATCH = 128
+
+
+def range_key_passes(batch: DeviceBatch, bound_keys):
+    """Stacked order-preserving uint64 passes [n_passes, padded] of the
+    range sort keys, with string keys truncated to RANGE_PREFIX_BYTES
+    (monotone coarsening — see module docstring)."""
+    import jax.numpy as jnp
+
+    cols = []
+    for k in bound_keys:
+        c = as_device_column(k.expr.eval_tpu(batch), batch.padded_rows)
+        if c.dtype.is_string:
+            bm, w = c.data, c.data.shape[1]
+            if w < RANGE_PREFIX_BYTES:
+                bm = jnp.pad(bm, ((0, 0), (0, RANGE_PREFIX_BYTES - w)))
+            else:
+                bm = bm[:, :RANGE_PREFIX_BYTES]
+            pos = jnp.arange(RANGE_PREFIX_BYTES, dtype=jnp.int32)[None, :]
+            bm = jnp.where(pos < c.lengths[:, None], bm, 0)
+            c = DeviceColumn(c.dtype, bm, c.validity,
+                             jnp.minimum(c.lengths, RANGE_PREFIX_BYTES))
+        cols.append(c)
+    passes = seg.key_passes_device(
+        cols,
+        descending=[not k.ascending for k in bound_keys],
+        nulls_first=[k.nulls_first for k in bound_keys])
+    return jnp.stack(passes)
+
+
+def range_pids_from_bounds(passes, bounds):
+    """pid = number of bounds the row exceeds lexicographically
+    (passes[j] dominates passes[j+1]); monotone in the sort order for
+    ANY bounds, so sample quality affects balance, never ordering."""
+    import jax.numpy as jnp
+
+    padded = passes.shape[1]
+    nb = bounds.shape[1]
+    eq = jnp.ones((padded, nb), dtype=jnp.bool_)
+    gt = jnp.zeros((padded, nb), dtype=jnp.bool_)
+    for j in range(passes.shape[0]):
+        pj = passes[j][:, None]
+        bj = bounds[j][None, :]
+        gt = gt | (eq & (pj > bj))
+        eq = eq & (pj == bj)
+    return gt.sum(axis=1).astype(jnp.int32)
+
+
+def pick_bounds_host(samples: np.ndarray, n_out: int) -> np.ndarray:
+    """Quantile bounds from the gathered uint64 sample passes
+    [n_passes, n_samples] (host side, like the reference's driver-side
+    bounds — GpuRangePartitioner.scala:68-104)."""
+    order = np.lexsort(samples[::-1])  # passes[0] dominates
+    v = samples.shape[1]
+    cuts = [min(max((v * (i + 1)) // n_out, 0), v - 1)
+            for i in range(n_out - 1)]
+    return samples[:, order[cuts]]
 
 
 def _free_shuffle_buffers(fw, store, spill_listener=None):
@@ -53,6 +125,15 @@ class TpuShuffleExchangeExec(TpuExec):
 
         self._hash_kernel = jax.jit(self._hash_pids)
         self._slice_kernel = jax.jit(self._slice)
+        if isinstance(self.partitioning, RangePartitioning):
+            self._passes_kernel = jax.jit(
+                lambda b: range_key_passes(
+                    b, self.partitioning._bound_keys))
+            self._range_pid_kernel = jax.jit(
+                lambda b, bounds: range_pids_from_bounds(
+                    range_key_passes(b, self.partitioning._bound_keys),
+                    bounds))
+            self._bounds_pid_kernel = jax.jit(range_pids_from_bounds)
 
     @property
     def schema(self):
@@ -67,7 +148,7 @@ class TpuShuffleExchangeExec(TpuExec):
         h = hashing.hash_device_batch(cols)
         return hashing.pmod(h, self.n_out).astype(jnp.int32)
 
-    def _pids(self, batch: DeviceBatch, rr_start: int = 0):
+    def _pids(self, batch: DeviceBatch, rr_start: int = 0, bounds=None):
         import jax.numpy as jnp
 
         if isinstance(self.partitioning, SinglePartitioning):
@@ -75,6 +156,10 @@ class TpuShuffleExchangeExec(TpuExec):
         if isinstance(self.partitioning, RoundRobinPartitioning):
             return ((jnp.arange(batch.padded_rows, dtype=jnp.int32)
                      + rr_start) % self.n_out)
+        if isinstance(self.partitioning, RangePartitioning):
+            if bounds is None:  # no sample (empty input): one partition
+                return jnp.zeros(batch.padded_rows, dtype=jnp.int32)
+            return self._range_pid_kernel(batch, bounds)
         return self._hash_kernel(batch)
 
     @staticmethod
@@ -101,7 +186,8 @@ class TpuShuffleExchangeExec(TpuExec):
         # the writer can always admit the child's device work.
         elect_lock = threading.Lock()
         done = threading.Event()
-        state = {"writer": False, "error": None}
+        state = {"writer": False, "error": None, "bounds": None}
+        is_range = isinstance(self.partitioning, RangePartitioning)
         sem = self._sem(ctx)
         # buf_id -> (id(device_batch), pids): partition ids are computed
         # once per resident batch and reused by all n_out readers; a
@@ -112,6 +198,8 @@ class TpuShuffleExchangeExec(TpuExec):
         def _drain_child():
             items = []  # (buffer id, round-robin start offset)
             rr = 0
+            samples = []   # device key samples for the range bounds
+            pending = []   # (buf_id, id(batch), passes) for pid prefill
             with trace_range("TpuShuffleWrite",
                              self.metrics[M.TOTAL_TIME]):
                 for pid in range(child.n_partitions):
@@ -119,8 +207,28 @@ class TpuShuffleExchangeExec(TpuExec):
                         n = int(b.num_rows)
                         if n == 0:
                             continue
-                        items.append((fw.add_batch(b), rr))
+                        if is_range:
+                            passes = self._passes_kernel(b)
+                            s = min(n, RANGE_SAMPLES_PER_BATCH)
+                            idx = (np.arange(s) * n) // s
+                            samples.append(np.asarray(passes[:, idx]))
+                        buf_id = fw.add_batch(b)
+                        if is_range:
+                            pending.append((buf_id, id(b), passes))
+                        items.append((buf_id, rr))
                         rr = (rr + n) % self.n_out
+            if is_range and samples:
+                import jax.numpy as jnp
+
+                bounds = jnp.asarray(pick_bounds_host(
+                    np.concatenate(samples, axis=1), self.n_out))
+                state["bounds"] = bounds
+                # reuse the write-time key passes: pid prefill while the
+                # batches are still resident (a spilled+promoted batch
+                # misses on the id check and recomputes via the kernel)
+                for buf_id, bid, passes in pending:
+                    pid_cache[buf_id] = (
+                        bid, self._bounds_pid_kernel(passes, bounds))
             store.append(items)
 
         def materialized():
@@ -175,7 +283,7 @@ class TpuShuffleExchangeExec(TpuExec):
             cached = pid_cache.get(buf_id)
             if cached is not None and cached[0] == id(b):
                 return cached[1]
-            pids = self._pids(b, rr_start)
+            pids = self._pids(b, rr_start, state["bounds"])
             pid_cache[buf_id] = (id(b), pids)
             return pids
 
@@ -213,23 +321,17 @@ class TpuShuffleExchangeExec(TpuExec):
 # ==========================================================================
 def register(register_exec):
     from ..plan import physical as P
-    from ..shuffle.partitioning import RangePartitioning
-
-    def tag(meta):
-        part = meta.plan.partitioning
-        if isinstance(part, RangePartitioning):
-            meta.will_not_work_on_tpu(
-                "range partitioning runs on the host engine "
-                "(driver-side sample bounds)")
 
     def exprs_of(plan: P.ShuffleExchangeExec):
         part = plan.partitioning
+        if isinstance(part, RangePartitioning):
+            keys = part._bound_keys or part.sort_keys
+            return [k.expr for k in keys]
         return list(getattr(part, "_bound", None)
                     or getattr(part, "keys", []) or [])
 
     register_exec(
         P.ShuffleExchangeExec,
         convert=lambda meta, ch: TpuShuffleExchangeExec(ch[0], meta.plan),
-        desc="device hash/single/round-robin exchange",
-        tag=tag,
+        desc="device hash/single/round-robin/range exchange",
         exprs_of=exprs_of)

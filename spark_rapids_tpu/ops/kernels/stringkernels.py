@@ -187,6 +187,20 @@ def endswith(bm, lengths, needle: bytes):
     return ok
 
 
+def locate_from(bm, lengths, needle: bytes, start):
+    """1-based byte position of the first match at offset >= ``start``
+    (a traced per-row int32 vector); 0 if absent.  The greedy-leftmost
+    building block of the device LIKE matcher."""
+    jnp = _jnp()
+    w = bm.shape[1]
+    match = _find(bm, lengths, needle)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    match = match & (pos >= start[:, None])
+    any_ = match.any(axis=1)
+    first = jnp.argmax(match, axis=1).astype(jnp.int32)
+    return jnp.where(any_, first + 1, 0)
+
+
 def locate(bm, lengths, needle: bytes, start_pos: int = 1):
     """1-based position of first match at/after start_pos; 0 if absent."""
     jnp = _jnp()

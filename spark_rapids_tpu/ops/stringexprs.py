@@ -364,15 +364,48 @@ class ConcatStrings(Expression):
 
 
 class Like(Expression):
-    """SQL LIKE with literal pattern — host engine only (the reference
-    translates LIKE to a cudf regex with escape bail-outs; here the
-    device bail-out is total, the host path is exact)."""
+    """SQL LIKE with literal pattern.
+
+    Device path (reference: the cudf regex translation with escape
+    bail-outs, stringFunctions.scala Like + rules
+    GpuOverrides.scala:326-371): patterns built only from literal text
+    and ``%`` lower onto the byte-matrix kernels — prefix/suffix/
+    contains and the general multi-``%`` shape via greedy leftmost
+    segment matching (correct for ``%`` because it matches any length).
+    Patterns using ``_`` (single-char, character-based) bail out to the
+    exact host regex, mirroring the reference's bail-outs.  Byte-level
+    segment matching is exact for valid UTF-8 (self-synchronizing: a
+    valid segment cannot match starting mid-character)."""
 
     def __init__(self, child, pattern: str, escape: str = "\\"):
         super().__init__([child])
         self.pattern = pattern
         self.escape = escape
         self._re = re.compile(self._to_regex(pattern, escape), re.DOTALL)
+        self._match = self._re.match  # LIKE regex is ^…$-anchored
+        self._segs = self._parse_segments(pattern, escape)
+
+    @staticmethod
+    def _parse_segments(pattern: str, escape: str):
+        """Split into literal byte segments on unescaped ``%``.
+        Returns None when the pattern uses ``_`` — host regex only."""
+        segs, cur, i = [], [], 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if ch == escape and i + 1 < len(pattern):
+                cur.append(pattern[i + 1])
+                i += 2
+                continue
+            if ch == "%":
+                segs.append("".join(cur))
+                cur = []
+            elif ch == "_":
+                return None
+            else:
+                cur.append(ch)
+            i += 1
+        segs.append("".join(cur))
+        return [s.encode("utf-8") for s in segs]
 
     @staticmethod
     def _to_regex(pattern: str, escape: str) -> str:
@@ -404,14 +437,44 @@ class Like(Expression):
         valid = c.is_valid()
         for i in range(n):
             if valid[i] and c.data[i] is not None:
-                out[i] = self._re.match(c.data[i]) is not None
+                out[i] = self._match(c.data[i]) is not None
         return HostColumn(T.BOOL, out, c.validity)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        c = as_device_column(self.children[0].eval_tpu(batch),
+                             batch.padded_rows)
+        bm, ln = c.data, c.lengths
+        segs = self._segs
+        n = bm.shape[0]
+        if len(segs) == 1:
+            # no wildcard at all: exact (length + prefix) equality
+            needle = segs[0]
+            ok = sk.startswith(bm, ln, needle) & (ln == len(needle))
+            return DeviceColumn(T.BOOL, ok, c.validity)
+        first, last, mids = segs[0], segs[-1], segs[1:-1]
+        ok = (sk.startswith(bm, ln, first) if first
+              else jnp.ones((n,), dtype=jnp.bool_))
+        cursor = jnp.full((n,), len(first), dtype=jnp.int32)
+        for seg in mids:
+            if not seg:
+                continue
+            pos1 = sk.locate_from(bm, ln, seg, cursor)
+            ok = ok & (pos1 > 0)
+            cursor = jnp.where(pos1 > 0, pos1 - 1 + len(seg), cursor)
+        if last:
+            ok = ok & sk.endswith(bm, ln, last) & \
+                (ln - len(last) >= cursor)
+        else:
+            ok = ok & (ln >= cursor)
+        return DeviceColumn(T.BOOL, ok, c.validity)
 
     @property
     def tpu_supported(self):
-        # pure-wildcard prefixes/suffixes could lower to starts/endswith;
-        # kept on host for exactness (round 1)
-        return False
+        # %-only patterns lower onto the byte-matrix kernels; `_`
+        # (character-based) bails out to the host regex
+        return self._segs is not None and self.children[0].tpu_supported
 
 
 class RegExpReplace(Expression):

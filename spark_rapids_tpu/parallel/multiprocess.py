@@ -49,14 +49,23 @@ def init_multiprocess(coordinator: str, num_processes: int,
     initializes (the 2-process CPU fixture the reference never had for
     its UCX path, SURVEY §4 "TPU-build implication")."""
     import os
+    import re
 
     if local_cpu_devices:
         os.environ["JAX_PLATFORMS"] = "cpu"
         flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count="
-                        f"{local_cpu_devices}").strip()
+        want = (f"--xla_force_host_platform_device_count="
+                f"{local_cpu_devices}")
+        if "host_platform_device_count" in flags:
+            # an inherited count (e.g. the pytest conftest's 8) must be
+            # REPLACED, not kept — otherwise every worker gets the
+            # inherited device count and the mesh silently changes size
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want,
+                flags)
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
     import jax
 
     if local_cpu_devices:

@@ -292,10 +292,12 @@ class DistributedRunner:
             pids = hashing.pmod(hashing.hash_device_batch(cols),
                                 n).astype(jnp.int32)
         elif isinstance(part, RangePartitioning):
-            # v1: total order via single partition + per-shard sort
-            # (range-partitioned sort == single-partition sort for
-            # correctness; sampled device bounds are a later round)
-            pids = jnp.zeros(batch.padded_rows, dtype=jnp.int32)
+            # sampled device bounds (reference:
+            # GpuRangePartitioner.scala:33-104) — the same traced
+            # sample/all_gather/bounds-compare the distributed sort
+            # uses, so rows spread across ALL shards in sort-key order
+            # instead of funnelling to shard 0
+            pids = self._range_pids(batch, part._bound_keys)
         else:
             raise DistributedUnsupported(
                 f"partitioning {type(part).__name__}")
@@ -321,11 +323,7 @@ class DistributedRunner:
         pids = jnp.where(batch.row_mask(), 0, self.n)
         return self.transport.exchange(batch, pids, self.n)
 
-    def _exchange_by_exprs(self, batch: DeviceBatch, exprs,
-                           schema) -> DeviceBatch:
-        """Collective hash repartition on expression keys (colocates
-        equal keys so per-shard group/window computation is globally
-        correct)."""
+    def _hash_pids_by_exprs(self, batch: DeviceBatch, exprs, schema):
         import jax.numpy as jnp
 
         from ..ops.expression import as_device_column, bind_references
@@ -335,7 +333,14 @@ class DistributedRunner:
                 for k in bound]
         pids = hashing.pmod(hashing.hash_device_batch(cols),
                             self.n).astype(jnp.int32)
-        pids = jnp.where(batch.row_mask(), pids, self.n)
+        return jnp.where(batch.row_mask(), pids, self.n)
+
+    def _exchange_by_exprs(self, batch: DeviceBatch, exprs,
+                           schema) -> DeviceBatch:
+        """Collective hash repartition on expression keys (colocates
+        equal keys so per-shard group/window computation is globally
+        correct)."""
+        pids = self._hash_pids_by_exprs(batch, exprs, schema)
         return self.transport.exchange(batch, pids, self.n)
 
     def _range_pids(self, batch: DeviceBatch, sort_keys):
@@ -415,10 +420,38 @@ class DistributedRunner:
 
     @staticmethod
     def _is_single(part) -> bool:
-        from ..shuffle.partitioning import (RangePartitioning,
-                                            SinglePartitioning)
+        from ..shuffle.partitioning import SinglePartitioning
 
-        return isinstance(part, (SinglePartitioning, RangePartitioning))
+        return isinstance(part, SinglePartitioning)
+
+    @staticmethod
+    def _range_keys(part):
+        """The bound SortKeys of a RangePartitioning, else None."""
+        from ..shuffle.partitioning import RangePartitioning
+
+        if not isinstance(part, RangePartitioning):
+            return None
+        return part._bound_keys or part.sort_keys
+
+    def _range_matches_sort(self, part, sort_keys) -> bool:
+        """True when the source range exchange partitions by exactly the
+        sort's keys — its shards are already in global key order, so a
+        per-shard sort + in-order concat is a total order."""
+        ks = self._range_keys(part)
+        if ks is None:
+            return False
+        try:
+            return [(k.expr.sql(), k.ascending, k.nulls_first)
+                    for k in ks] == \
+                [(k.expr.sql(), k.ascending, k.nulls_first)
+                 for k in sort_keys]
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _sort_presorted(self, kid, op) -> bool:
+        src = self._source_partitioning(kid)
+        return self._is_single(src) or \
+            self._range_matches_sort(src, op.keys)
 
     @staticmethod
     def _hash_keys_match(part, exprs) -> bool:
@@ -509,11 +542,30 @@ class DistributedRunner:
                     single_ok = (self._is_single(lpart)
                                  and self._is_single(rpart))
                     if not (keys_ok or single_ok):
-                        raise DistributedUnsupported(
-                            "shuffled join children are not colocated "
-                            f"on the join keys (left={lpart!r}, "
-                            f"right={rpart!r}) — plan shape would "
-                            "produce wrong rows")
+                        if self._range_keys(lpart) is not None or \
+                                self._range_keys(rpart) is not None:
+                            # range exchanges place rows by their OWN
+                            # sampled bounds, so two range-partitioned
+                            # children are not colocated with each
+                            # other: hash re-exchange both sides on
+                            # the join keys (capped, so padded size
+                            # doesn't inflate P-fold)
+                            lb = self._capped_exchange(
+                                lb, self._hash_pids_by_exprs(
+                                    lb, op.plan.left_keys,
+                                    op.children[0].schema),
+                                f"jexl{id(op)}", aux, caps, used_caps)
+                            rb = self._capped_exchange(
+                                rb, self._hash_pids_by_exprs(
+                                    rb, op.plan.right_keys,
+                                    op.children[1].schema),
+                                f"jexr{id(op)}", aux, caps, used_caps)
+                        else:
+                            raise DistributedUnsupported(
+                                "shuffled join children are not "
+                                "colocated on the join keys "
+                                f"(left={lpart!r}, right={rpart!r}) — "
+                                "plan shape would produce wrong rows")
                 key = f"join{id(op)}"
                 cap = caps.get(key)
                 if cap is None:
@@ -551,8 +603,7 @@ class DistributedRunner:
                 # bottleneck (reference: GpuRangePartitioning + per-task
                 # sort under Spark's range exchange)
                 child = self._lower(kids[0], env, aux, caps, used_caps)
-                if not self._is_single(
-                        self._source_partitioning(kids[0])):
+                if not self._sort_presorted(kids[0], op):
                     pids = self._range_pids(child, op.keys)
                     child = self._capped_exchange(
                         child, pids, f"rexch{id(op)}", aux, caps,
@@ -610,20 +661,36 @@ class DistributedRunner:
         (static output capacity) and capped exchanges (per-destination
         tile capacity)."""
         from ..exec.exchange import TpuShuffleExchangeExec
-        from ..exec.joins import TpuHashJoinExec
+        from ..exec.joins import (TpuBroadcastHashJoinExec,
+                                  TpuHashJoinExec)
         from ..exec.sort import TpuSortExec
         from ..shuffle.partitioning import SinglePartitioning
 
         if isinstance(node, tuple):
             if isinstance(node[0], TpuHashJoinExec):
-                out.append(f"join{id(node[0])}")
+                op = node[0]
+                out.append(f"join{id(op)}")
+                if not isinstance(op, TpuBroadcastHashJoinExec):
+                    # mirror the repair-exchange decision in _lower
+                    lpart = self._source_partitioning(node[1])
+                    rpart = self._source_partitioning(node[2])
+                    keys_ok = (
+                        self._hash_keys_match(lpart, op.plan.left_keys)
+                        and self._hash_keys_match(rpart,
+                                                  op.plan.right_keys))
+                    single_ok = (self._is_single(lpart)
+                                 and self._is_single(rpart))
+                    if not (keys_ok or single_ok) and (
+                            self._range_keys(lpart) is not None
+                            or self._range_keys(rpart) is not None):
+                        out.append(f"jexl{id(op)}")
+                        out.append(f"jexr{id(op)}")
             if isinstance(node[0], TpuShuffleExchangeExec) and \
                     not isinstance(node[0].partitioning,
                                    SinglePartitioning):
                 out.append(f"exch{id(node[0])}")
             if isinstance(node[0], TpuSortExec) and \
-                    not self._is_single(
-                        self._source_partitioning(node[1])):
+                    not self._sort_presorted(node[1], node[0]):
                 out.append(f"rexch{id(node[0])}")
             for k in node[1:]:
                 self._collect_aux_keys(k, out)

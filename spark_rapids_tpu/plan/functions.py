@@ -146,6 +146,9 @@ class Column:
                 self.pattern = pat
                 self.escape = "\\"
                 self._re = _re.compile(pat)
+                # Spark RLIKE is an unanchored find, not a full match
+                self._match = self._re.search
+                self._segs = None  # full regex: host engine only
 
         return Column(_RLike(self.expr, pattern))
 

@@ -39,7 +39,9 @@ HOST_ONLY_EXECS = {
 # bail-outs at GpuOverrides.scala:326-371 and the string/TZ gates)
 INTENTIONAL_HOST_EXPRS = {
     "UnresolvedAttribute",    # always bound before evaluation
-    "Like", "RegExpReplace",  # regex-class: host fallback by design
+    "RegExpReplace",          # full regex: host fallback by design
+    # (Like lowers %-only patterns on device; `_` patterns fall back
+    # per-instance via tpu_supported)
     "StringReplace", "SubstringIndex",  # variable-width rewrite on host
     "UnixTimestampParse", "FromUnixTime",  # strftime parse/format on host
     "InputFileName", "InputFileBlockStart",

@@ -179,6 +179,56 @@ def test_distributed_tpch_query(qnum):
     _assert_rows_equal(got, exp)
 
 
+def test_distributed_range_exchange_spreads_shards():
+    """The explicit RangePartitioning exchange node distributes by
+    sampled device bounds (reference: GpuRangePartitioner.scala:33-104)
+    — rows must land on many shards in key order, not funnel to shard 0
+    (r3 Weak: the v1 single-shard funnel)."""
+    from spark_rapids_tpu import Session, f
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.parallel.runner import DistributedRunner
+    from spark_rapids_tpu.plan.physical import ExecContext
+    from spark_rapids_tpu.shuffle.partitioning import RangePartitioning
+
+    rng = np.random.RandomState(33)
+    n = 4000
+    data = {"v": rng.randint(-10000, 10000, n),
+            "w": rng.rand(n).round(6)}
+
+    sess = Session()
+    df = sess.create_dataframe(dict(data)).sort(f.col("v"))
+    phys = sess.physical_plan(df.plan)
+
+    # the plan must carry a DEVICE range exchange (no host fallback)
+    found = []
+
+    def walk(node):
+        if isinstance(node, TpuShuffleExchangeExec) and \
+                isinstance(node.partitioning, RangePartitioning):
+            found.append(node)
+        for c in getattr(node, "children", []):
+            walk(c)
+
+    walk(phys)
+    assert found, "sort plan lost its device range exchange"
+
+    captured = {}
+
+    class Capture(DistributedRunner):
+        def _collect_output(self, out, stages):
+            captured["num_rows"] = np.asarray(out.num_rows)
+            return super()._collect_output(out, stages)
+
+    got = Capture(_mesh(8)).run(phys, ExecContext(sess.conf, sess))
+    exp = sess.create_dataframe(dict(data)).sort(f.col("v")).collect()
+    got_rows = got.to_rows()
+    assert len(got_rows) == len(exp)
+    assert [g[0] for g in got_rows] == [e[0] for e in exp]
+    shards_with_rows = int((captured["num_rows"] > 0).sum())
+    assert shards_with_rows >= 4, \
+        f"range exchange funneled rows to {shards_with_rows} shard(s)"
+
+
 def test_distributed_range_sort_no_gather():
     """Distributed sort of raw rows: range-exchange by sampled key
     bounds (device, traced) then per-shard sort — shard i's rows all

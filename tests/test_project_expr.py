@@ -188,3 +188,41 @@ def test_strict_mode_catches_fallback(strict_tpu_session):
     df = strict_tpu_session.create_dataframe({"s": ["a", "b"]})
     with pytest.raises(AssertionError):
         df.select(df["s"].rlike("a.*").alias("m")).collect()
+
+
+@pytest.mark.parametrize("pattern", [
+    "MEDIUM POLISHED%",      # prefix (TPC-H q16 shape)
+    "%BRASS",                # suffix (q16 NOT LIKE shape)
+    "%green%",               # contains (q20 shape)
+    "abc",                   # exact
+    "",                      # empty pattern: only empty string
+    "%",                     # matches everything
+    "a%b%c",                 # multi-segment greedy
+    "%a%%b%",                # adjacent % (empty segments)
+    "50\\%%",                # escaped % then wildcard
+], ids=["prefix", "suffix", "contains", "exact", "empty", "any",
+        "multi", "adjacent", "escaped"])
+def test_like_device(pattern):
+    data = {"s": ["MEDIUM POLISHED TIN", "LARGE BRUSHED BRASS",
+                  "dark green metallic", "abc", "", "a-b-c", "ab",
+                  "aXbYc", "50% off", "50c", None, "abcabc",
+                  "MEDIUM POLISHED", "xMEDIUM POLISHED TIN"]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["s"].like(pattern).alias("m")), data)
+
+
+def test_like_simple_pattern_stays_on_device(strict_tpu_session):
+    # reference keeps Like on GPU via regex translation
+    # (GpuOverrides.scala:326-371); here %-only patterns lower onto the
+    # byte-matrix kernels — strict mode proves no host fallback
+    df = strict_tpu_session.create_dataframe(
+        {"s": ["MEDIUM POLISHED TIN", "SMALL PLATED COPPER", None]})
+    out = df.select(df["s"].like("MEDIUM POLISHED%").alias("m")).collect()
+    assert [r[0] for r in out] == [True, False, None]
+
+
+def test_like_underscore_falls_back(strict_tpu_session):
+    # `_` is character-based -> host regex; strict mode must raise
+    df = strict_tpu_session.create_dataframe({"s": ["ab", "ax"]})
+    with pytest.raises(AssertionError):
+        df.select(df["s"].like("a_").alias("m")).collect()

@@ -37,6 +37,19 @@ def test_tpch_query_cpu_vs_tpu(qnum):
                       approximate_float=1e-6)
 
 
+def test_tpch_q16_like_stays_on_device():
+    """q16's `p_type NOT LIKE 'MEDIUM POLISHED%'` must lower onto the
+    device byte-matrix kernels (reference keeps Like on GPU via regex
+    translation, GpuOverrides.scala:326-371); strict test mode raises
+    on any unexpected host fallback."""
+    sess = Session({"spark.rapids.tpu.sql.test.enabled": True})
+    tables = tpch_datagen.dataframes(sess, sf=SF, seed=SEED)
+    rows = tpch.QUERIES[16](tables).collect()
+    cpu_rows, _ = _run(16, tpu=False)
+    assert_rows_equal(cpu_rows, rows, ignore_order=True,
+                      approximate_float=1e-6)
+
+
 def test_tpch_nonempty_coverage():
     """The generator must feed every query a non-trivial subset (guards
     against the suite silently comparing empty results everywhere)."""
