@@ -259,6 +259,54 @@ def _shuffle_microbench():
             "rows": n, "bytes": nbytes, "noise_pct": round(noise, 1)}
 
 
+def _q6_scan_breakdown(raw, iters=3):
+    """Scan-bound q6 from PARQUET files: end-to-end wall vs host-decode
+    wall, so scan-bound queries stop silently measuring pyarrow
+    (VERDICT r3 #9).  decode_frac is the share of the end-to-end time a
+    pure host pyarrow decode of the projected columns takes; the
+    decode/upload prefetch pipeline (exec/transitions.py) is what keeps
+    the device busy under it (reference intent: semaphore held only for
+    device work, GpuParquetScan.scala:554-556)."""
+    import shutil
+    import tempfile
+
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.io.scans import expand_paths
+    from spark_rapids_tpu.session import Session
+
+    schema, cols = raw["lineitem"]
+    tmp = tempfile.mkdtemp(prefix="srt_bench_q6_")
+    try:
+        path = os.path.join(tmp, "lineitem")
+        host = Session(tpu_enabled=False)
+        host.create_dataframe(
+            {c: v for c, v in cols.items()}, schema,
+            n_partitions=4).write_parquet(path)
+        files = [f for f in expand_paths([path])]
+        fbytes = sum(os.path.getsize(f) for f in files)
+
+        tpu = Session(dict(PRESSURE_CONF))
+        q6 = tpch.QUERIES[6]({"lineitem": tpu.read_parquet(path)})
+        total_s, _ = _best(lambda: q6.collect(), iters=iters, warmup=1)
+
+        import pyarrow.parquet as paq
+
+        needed = ["l_shipdate", "l_discount", "l_quantity",
+                  "l_extendedprice"]
+
+        def decode_only():
+            for f in files:
+                paq.read_table(f, columns=needed)
+
+        decode_s, _ = _best(decode_only, iters=iters, warmup=1)
+        return {"total_s": round(total_s, 4),
+                "host_decode_s": round(decode_s, 4),
+                "decode_frac": round(decode_s / total_s, 3),
+                "file_gb_per_s": round(fbytes / total_s / 1e9, 3)}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _q1_pipeline_mrows():
     import jax
 
@@ -365,6 +413,10 @@ def main():
     remaining = _deadline() - time.perf_counter()
     shuffle = _shuffle_microbench() if remaining > 20 else None
     remaining = _deadline() - time.perf_counter()
+    q6_scan = _q6_scan_breakdown(raw) if remaining > 25 else None
+    if q6_scan is not None:
+        _emit({"progress": "q6_scan", **q6_scan})
+    remaining = _deadline() - time.perf_counter()
     q1p = _q1_pipeline_mrows() if remaining > 15 else None
 
     _emit({
@@ -381,6 +433,7 @@ def main():
         "elapsed_s": round(time.perf_counter() - _T0, 1),
         "per_query": per_query,
         "shuffle_write": shuffle,
+        "q6_scan": q6_scan,
         "q1_pipeline": q1p,
     })
 

@@ -168,6 +168,11 @@ READER_BATCH_SIZE_ROWS = conf("spark.rapids.tpu.sql.reader.batchSizeRows").doc(
     "spark.rapids.sql.reader.batchSizeRows)").int_conf(1 << 21)
 READER_BATCH_SIZE_BYTES = conf("spark.rapids.tpu.sql.reader.batchSizeBytes").doc(
     "Soft cap on bytes per reader batch").long_conf(512 * 1024 * 1024)
+READER_PREFETCH_BATCHES = conf(
+    "spark.rapids.tpu.sql.reader.prefetchBatches").doc(
+    "Host batches decoded ahead of the device upload per partition "
+    "(decode/upload pipelining; 0 disables the prefetch thread)"
+).int_conf(2)
 BUCKET_MIN_ROWS = conf("spark.rapids.tpu.sql.bucketMinRows").doc(
     "Device batches are padded to power-of-two row buckets >= this, so XLA "
     "compile caches hit across batches (TPU-specific: static shapes)").int_conf(128)

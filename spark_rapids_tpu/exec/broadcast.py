@@ -1,0 +1,145 @@
+"""Reusable broadcast artifact.
+
+Reference analogue: GpuBroadcastExchangeExec.scala:215-247 — the build
+side of a broadcast join is materialized ONCE (serialized host buffers
++ lazy device re-upload on executors) and the same artifact is shared
+by every consumer of the exchange.  The TPU-native form registers the
+built single-batch with the spill framework: it is spillable to
+host/disk (the serialization analogue) and `acquire` transparently
+re-uploads it to HBM on next use (the lazy re-upload analogue).  A
+session-level registry keyed by the canonical build subtree shares one
+artifact across consuming joins AND across repeated collects of the
+same plan (the reference gets the latter from Spark's broadcast
+variable caching, the former from ReuseExchange canonicalization).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable, Dict, Optional
+
+from ..data.column import DeviceBatch
+from ..memory.spill import SpillPriorities
+
+
+def canonical_key(exec_node) -> tuple:
+    """Identity of a build subtree: a weakref to the subtree's root
+    exec.  Reuse therefore happens exactly when the SAME physical
+    subtree object is consumed again — across repeated collects (the
+    session plan cache keeps one physical tree per logical plan) and
+    across stream partitions/retries within a query — and can never
+    alias different data or different expressions.  Cross-consumer
+    sharing of equal-but-distinct subtrees is the planner's job
+    (reference: ReuseExchange canonicalization), not this key's.  A
+    dead ref never matches a new plan, so recycled ids don't alias."""
+    try:
+        ident = weakref.ref(exec_node)
+    except TypeError:
+        ident = id(exec_node)
+    return (type(exec_node).__name__, ident, ())
+
+
+def _key_live(key) -> bool:
+    for el in key:
+        if isinstance(el, tuple):
+            if not _key_live(el):
+                return False
+        elif isinstance(el, weakref.ref) and el() is None:
+            return False
+    return True
+
+
+class BroadcastArtifact:
+    """One built broadcast batch, registered spillable."""
+
+    def __init__(self, fw, buf_id: int, schema):
+        self._fw = fw
+        self.buf_id = buf_id
+        self.schema = schema
+
+    def acquire(self) -> DeviceBatch:
+        """Pin on device (re-uploads if spilled).  Pair with
+        release()."""
+        return self._fw.acquire_batch(self.buf_id)
+
+    def release(self) -> None:
+        self._fw.release_batch(self.buf_id)
+
+    def free(self) -> None:
+        self._fw.remove_batch(self.buf_id)
+
+
+class BroadcastRegistry:
+    """Session-scoped artifact cache: canonical key -> artifact.
+
+    ``get_or_build`` runs the builder at most once per key (per-key
+    build lock, so two stream partitions racing on the same broadcast
+    block instead of double-building)."""
+
+    def __init__(self, fw):
+        self._fw = fw
+        self._lock = threading.Lock()
+        self._arts: Dict[tuple, BroadcastArtifact] = {}
+        self._build_locks: Dict[tuple, threading.Lock] = {}
+        #: observability: how many times a builder actually ran
+        self.builds = 0
+
+    def get_or_build(self, key: tuple,
+                     builder: Callable[[], DeviceBatch],
+                     schema, sem=None) -> BroadcastArtifact:
+        self._purge_dead()
+        with self._lock:
+            art = self._arts.get(key)
+            if art is not None:
+                return art
+            bl = self._build_locks.setdefault(key, threading.Lock())
+        if not bl.acquire(blocking=False):
+            # never wait on another task's build while holding the
+            # device (the lock-order-inversion rule the exchange's
+            # writer election follows — r3 Weak #2): drop the hold,
+            # wait, re-admit
+            if sem is not None:
+                sem.release_all()
+            bl.acquire()
+            if sem is not None:
+                sem.acquire_if_necessary()
+        try:
+            with self._lock:
+                art = self._arts.get(key)
+                if art is not None:
+                    return art
+            batch = builder()
+            # broadcast data is hot across the whole query: spill last
+            # among outputs (reference: SpillPriorities.scala input
+            # band sits above shuffle outputs)
+            buf_id = self._fw.add_batch(
+                batch, priority=SpillPriorities.ACTIVE_ON_DECK)
+            art = BroadcastArtifact(self._fw, buf_id, schema)
+            with self._lock:
+                self._arts[key] = art
+                self.builds += 1
+            return art
+        finally:
+            bl.release()
+
+    def _purge_dead(self) -> None:
+        """Free artifacts whose source plan died (their keys can never
+        match again — without this, dead-plan artifacts would pin
+        spill-store memory for the session's life)."""
+        with self._lock:
+            dead = [k for k in self._arts if not _key_live(k)]
+            arts = [self._arts.pop(k) for k in dead]
+            for k in dead:
+                self._build_locks.pop(k, None)
+        for a in arts:
+            a.free()
+
+    def clear(self) -> None:
+        with self._lock:
+            arts = list(self._arts.values())
+            self._arts.clear()
+        for a in arts:
+            a.free()
+
+    def __len__(self) -> int:
+        return len(self._arts)

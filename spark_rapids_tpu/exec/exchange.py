@@ -105,9 +105,13 @@ def pick_bounds_host(samples: np.ndarray, n_out: int) -> np.ndarray:
     return samples[:, order[cuts]]
 
 
-def _free_shuffle_buffers(fw, store, spill_listener=None):
-    for buf_id, _rr in (store[0] if store else ()):
-        fw.remove_batch(buf_id)
+def _free_shuffle_buffers(fw, store, spill_listener=None,
+                          catalog=None, shuffle_id=None):
+    if catalog is not None and shuffle_id is not None:
+        catalog.unregister_shuffle(shuffle_id)  # idempotent
+    else:
+        for buf_id, _rr in (store[0] if store else ()):
+            fw.remove_batch(buf_id)
     if spill_listener is not None:
         try:
             fw.spill_listeners.remove(spill_listener)
@@ -177,6 +181,15 @@ class TpuShuffleExchangeExec(TpuExec):
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
         store: List[list] = []
+        # shuffle-scoped buffer group (reference: ShuffleBufferCatalog
+        # shuffleId->mapId->buffers index + per-shuffle cleanup)
+        catalog = shuffle_id = None
+        if ctx is not None and getattr(ctx, "session", None) is not None:
+            catalog = getattr(ctx.session, "shuffle_catalog", None)
+        if catalog is not None:
+            shuffle_id = catalog.register_shuffle()
+            if hasattr(ctx, "shuffle_ids"):
+                ctx.shuffle_ids.append(shuffle_id)
         # Writer election instead of a lock held across the child drain:
         # the old form (write_lock around the drain) deadlocked under
         # the device semaphore — the writer blocked inside the child on
@@ -200,6 +213,10 @@ class TpuShuffleExchangeExec(TpuExec):
             rr = 0
             samples = []   # device key samples for the range bounds
             pending = []   # (buf_id, id(batch), passes) for pid prefill
+            # passes are unspillable HBM; cap what the prefill may pin
+            # so a long shuffle write can't defeat the spill framework
+            # (batches past the cap recompute pids at first read)
+            pend_budget = 64 * 1024 * 1024
             with trace_range("TpuShuffleWrite",
                              self.metrics[M.TOTAL_TIME]):
                 for pid in range(child.n_partitions):
@@ -213,8 +230,11 @@ class TpuShuffleExchangeExec(TpuExec):
                             idx = (np.arange(s) * n) // s
                             samples.append(np.asarray(passes[:, idx]))
                         buf_id = fw.add_batch(b)
-                        if is_range:
+                        if catalog is not None:
+                            catalog.add_buffer(shuffle_id, pid, buf_id)
+                        if is_range and pend_budget > 0:
                             pending.append((buf_id, id(b), passes))
+                            pend_budget -= passes.size * 8
                         items.append((buf_id, rr))
                         rr = (rr + n) % self.n_out
             if is_range and samples:
@@ -305,11 +325,13 @@ class TpuShuffleExchangeExec(TpuExec):
             return it
 
         result = DevicePartitionedData([make(i) for i in range(self.n_out)])
-        # free the shuffle buffers from the global catalog when the read
-        # side is dropped (reference: per-shuffle cleanup in
-        # ShuffleBufferCatalog; without this every query's shuffle data
-        # stays resident for the life of the process)
-        weakref.finalize(result, _free_shuffle_buffers, fw, store, on_spill)
+        # free the shuffle buffers when the read side is dropped — the
+        # backstop behind the query-end per-shuffle cleanup in
+        # Session.execute (reference: ShuffleBufferCatalog cleanup;
+        # without either, every query's shuffle data stays resident for
+        # the life of the process)
+        weakref.finalize(result, _free_shuffle_buffers, fw, store,
+                         on_spill, catalog, shuffle_id)
         return result
 
     def describe(self):

@@ -307,44 +307,56 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
         return [TargetSize(), RequireSingleBatch()]
 
     def execute_columnar(self, ctx):
-        import threading
+        from .broadcast import canonical_key
 
         left = self.children[0].execute_columnar(ctx)
-        right = self.children[1].execute_columnar(ctx)
         self._init_metrics(ctx)
-        built = []  # lazily built once, shared by all partitions
-        build_lock = threading.Lock()
+        reg = sem = None
+        if ctx is not None and getattr(ctx, "session", None) is not None:
+            reg = getattr(ctx.session, "broadcast_registry", None)
+            dm = ctx.session.device_manager
+            sem = dm.semaphore if dm is not None else None
+        assert reg is not None, \
+            "broadcast join requires the device session's registry"
+        key = canonical_key(self.children[1])
 
-        def build() -> DeviceBatch:
-            with build_lock:
-                if not built:
-                    batches = []
-                    for pid in range(right.n_partitions):
-                        batches.extend(right.iterator(pid))
-                    if batches:
-                        built.append(concat_device_batches(batches)
-                                     if len(batches) > 1 else batches[0])
-                    else:
-                        from ..data.column import host_to_device
-                        from ..plan.physical import _empty_batch
+        def build_batch() -> DeviceBatch:
+            # the build child executes ONLY when the artifact is not
+            # cached yet (reference: the broadcast relation future runs
+            # once, GpuBroadcastExchangeExec.scala:247)
+            right = self.children[1].execute_columnar(ctx)
+            batches = []
+            for pid in range(right.n_partitions):
+                batches.extend(right.iterator(pid))
+            if batches:
+                return (concat_device_batches(batches)
+                        if len(batches) > 1 else batches[0])
+            from ..data.column import host_to_device
+            from ..plan.physical import _empty_batch
 
-                        built.append(host_to_device(
-                            _empty_batch(self.children[1].schema)))
-                return built[0]
+            return host_to_device(_empty_batch(self.children[1].schema))
 
         def make(pid):
             def it():
+                art = reg.get_or_build(key, build_batch,
+                                       self.children[1].schema, sem=sem)
                 streamed = False
                 for lb in left.iterator(pid):
                     streamed = True
-                    rb = build()
-                    yield self._metrics_wrap(
-                        lambda: self._join(lb, rb))
+                    rb = art.acquire()  # lazy re-upload if spilled
+                    try:
+                        yield self._metrics_wrap(
+                            lambda: self._join(lb, rb))
+                    finally:
+                        art.release()
                 if not streamed:
                     lb = self._one_batch_empty(0)
-                    rb = build()
-                    yield self._metrics_wrap(
-                        lambda: self._join(lb, rb))
+                    rb = art.acquire()
+                    try:
+                        yield self._metrics_wrap(
+                            lambda: self._join(lb, rb))
+                    finally:
+                        art.release()
 
             return it
 

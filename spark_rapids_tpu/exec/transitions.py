@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from ..data.column import device_to_host, host_to_device
 from ..config import (BUCKET_MIN_ROWS, READER_BATCH_SIZE_BYTES,
-                      READER_BATCH_SIZE_ROWS)
+                      READER_BATCH_SIZE_ROWS, READER_PREFETCH_BATCHES)
 from ..plan.physical import PartitionedData
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
@@ -60,22 +60,86 @@ class HostToDeviceExec(TpuExec):
         min_rows = ctx.conf.get(BUCKET_MIN_ROWS)
         max_rows = ctx.conf.get(READER_BATCH_SIZE_ROWS)
         max_bytes = ctx.conf.get(READER_BATCH_SIZE_BYTES)
+        prefetch = ctx.conf.get(READER_PREFETCH_BATCHES)
+
+        def upload(hb):
+            if sem:
+                sem.acquire_if_necessary()
+            with trace_range("HostToDevice",
+                             self.metrics[M.TOTAL_TIME]):
+                db = host_to_device(hb, min_rows)
+            self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
+            self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+            return db
 
         def make(pid):
-            def it():
+            def it_inline():
                 for batch in child_data.iterator(pid):
                     for hb in _split_host_batch(batch, max_rows,
                                                 max_bytes):
-                        if sem:
-                            sem.acquire_if_necessary()
-                        with trace_range("HostToDevice",
-                                         self.metrics[M.TOTAL_TIME]):
-                            db = host_to_device(hb, min_rows)
-                        self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
-                        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
-                        yield db
+                        yield upload(hb)
 
-            return it
+            def it_pipelined():
+                # decode/upload overlap: a host-only producer thread
+                # decodes ahead (bounded queue) while this task uploads
+                # and computes — the scan-bound analogue of the
+                # reference holding the semaphore only for device work
+                # (GpuParquetScan.scala:554-556).  The producer never
+                # touches the device, so it needs no semaphore.
+                import queue
+                import threading
+
+                q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+                stop = threading.Event()
+                END = object()
+
+                def put(item) -> bool:
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            return True
+                        except queue.Full:
+                            continue
+                    return False
+
+                def produce():
+                    try:
+                        for batch in child_data.iterator(pid):
+                            for hb in _split_host_batch(
+                                    batch, max_rows, max_bytes):
+                                if not put(hb):
+                                    return
+                        put(END)
+                    except BaseException as e:  # noqa: BLE001
+                        put(e)
+
+                t = threading.Thread(
+                    target=produce, daemon=True,
+                    name=f"h2d-prefetch-{pid}")
+                t.start()
+                try:
+                    while True:
+                        try:
+                            item = q.get_nowait()
+                        except queue.Empty:
+                            # never block on the producer while holding
+                            # the device — the producer may itself need
+                            # a permit (host-fallback sandwich plans run
+                            # device sections inside the child), and a
+                            # held-while-blocked permit is the exact
+                            # shape of the r3 deadlocks
+                            if sem:
+                                sem.release_all()
+                            item = q.get()
+                        if item is END:
+                            break
+                        if isinstance(item, BaseException):
+                            raise item
+                        yield upload(item)
+                finally:
+                    stop.set()
+
+            return it_pipelined if prefetch > 0 else it_inline
 
         return DevicePartitionedData(
             [make(i) for i in range(child_data.n_partitions)])

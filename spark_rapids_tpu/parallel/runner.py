@@ -78,6 +78,16 @@ class _StageRef:
         self.partitioning = partitioning
 
 
+class _BcastRef:
+    """Placeholder for a precomputed (replicated) broadcast build side —
+    gathered ONCE per query, reused across capacity retries and stream
+    partitions (reference: GpuBroadcastExchangeExec.scala:215-247
+    materializes the relation once and shares it)."""
+
+    def __init__(self, op):
+        self.op = op
+
+
 class _Stage:
     def __init__(self, sid: int, root):
         self.sid = sid
@@ -453,6 +463,28 @@ class DistributedRunner:
         return self._is_single(src) or \
             self._range_matches_sort(src, op.keys)
 
+    def _join_colocation(self, op, lkid, rkid) -> str:
+        """Shared verdict for a shuffled join's child distribution —
+        the ONE predicate both _lower and _collect_aux_keys consult, so
+        the aux-key mirror can never drift from the lowering (a missed
+        aux key silently drops overflowing rows).
+        Returns 'ok' | 'repair' (hash re-exchange both sides) |
+        'unsupported'."""
+        lpart = self._source_partitioning(lkid)
+        rpart = self._source_partitioning(rkid)
+        keys_ok = (self._hash_keys_match(lpart, op.plan.left_keys)
+                   and self._hash_keys_match(rpart, op.plan.right_keys))
+        single_ok = self._is_single(lpart) and self._is_single(rpart)
+        if keys_ok or single_ok:
+            return "ok"
+        if self._range_keys(lpart) is not None or \
+                self._range_keys(rpart) is not None:
+            # range exchanges place rows by their OWN sampled bounds,
+            # so two range-partitioned children are not colocated with
+            # each other
+            return "repair"
+        return "unsupported"
+
     @staticmethod
     def _hash_keys_match(part, exprs) -> bool:
         from ..shuffle.partitioning import HashPartitioning
@@ -526,46 +558,36 @@ class DistributedRunner:
                 return self._lower(kids[0], env, aux, caps, used_caps)
             if isinstance(op, TpuHashJoinExec):
                 lb = self._lower(kids[0], env, aux, caps, used_caps)
-                rb = self._lower(kids[1], env, aux, caps, used_caps)
                 if isinstance(op, TpuBroadcastHashJoinExec):
-                    rb = self.transport.replicate(rb)
+                    rb = env.get(f"bcast{id(op)}")
+                    if rb is None:  # no precompute (nested build side)
+                        rb = self.transport.replicate(self._lower(
+                            kids[1], env, aux, caps, used_caps))
                 else:
+                    rb = self._lower(kids[1], env, aux, caps, used_caps)
                     # colocation is a correctness invariant, not a
                     # planner courtesy: verify both sides arrive
                     # hash-partitioned on the join keys (or single)
-                    lpart = self._source_partitioning(kids[0])
-                    rpart = self._source_partitioning(kids[1])
-                    keys_ok = (
-                        self._hash_keys_match(lpart, op.plan.left_keys)
-                        and self._hash_keys_match(rpart,
-                                                  op.plan.right_keys))
-                    single_ok = (self._is_single(lpart)
-                                 and self._is_single(rpart))
-                    if not (keys_ok or single_ok):
-                        if self._range_keys(lpart) is not None or \
-                                self._range_keys(rpart) is not None:
-                            # range exchanges place rows by their OWN
-                            # sampled bounds, so two range-partitioned
-                            # children are not colocated with each
-                            # other: hash re-exchange both sides on
-                            # the join keys (capped, so padded size
-                            # doesn't inflate P-fold)
-                            lb = self._capped_exchange(
-                                lb, self._hash_pids_by_exprs(
-                                    lb, op.plan.left_keys,
-                                    op.children[0].schema),
-                                f"jexl{id(op)}", aux, caps, used_caps)
-                            rb = self._capped_exchange(
-                                rb, self._hash_pids_by_exprs(
-                                    rb, op.plan.right_keys,
-                                    op.children[1].schema),
-                                f"jexr{id(op)}", aux, caps, used_caps)
-                        else:
-                            raise DistributedUnsupported(
-                                "shuffled join children are not "
-                                "colocated on the join keys "
-                                f"(left={lpart!r}, right={rpart!r}) — "
-                                "plan shape would produce wrong rows")
+                    verdict = self._join_colocation(op, kids[0], kids[1])
+                    if verdict == "repair":
+                        # hash re-exchange both sides on the join keys
+                        # (capped, so padded size doesn't inflate
+                        # P-fold)
+                        lb = self._capped_exchange(
+                            lb, self._hash_pids_by_exprs(
+                                lb, op.plan.left_keys,
+                                op.children[0].schema),
+                            f"jexl{id(op)}", aux, caps, used_caps)
+                        rb = self._capped_exchange(
+                            rb, self._hash_pids_by_exprs(
+                                rb, op.plan.right_keys,
+                                op.children[1].schema),
+                            f"jexr{id(op)}", aux, caps, used_caps)
+                    elif verdict == "unsupported":
+                        raise DistributedUnsupported(
+                            "shuffled join children are not colocated "
+                            "on the join keys — plan shape would "
+                            "produce wrong rows")
                 key = f"join{id(op)}"
                 cap = caps.get(key)
                 if cap is None:
@@ -645,21 +667,37 @@ class DistributedRunner:
 
     @staticmethod
     def _env_key(ref) -> str:
-        return (f"leaf{ref.idx}" if isinstance(ref, _LeafRef)
-                else f"stage{ref.stage_id}")
+        if isinstance(ref, _LeafRef):
+            return f"leaf{ref.idx}"
+        if isinstance(ref, _BcastRef):
+            return f"bcast{id(ref.op)}"
+        return f"stage{ref.stage_id}"
 
     # ---------------- stage execution ---------------------------------
-    def _collect_refs(self, node, out: List):
+    def _collect_refs(self, node, out: List, cut_broadcast=False):
+        """Inputs of a stage program in trace order.  With
+        ``cut_broadcast`` the build subtree of each broadcast join is
+        replaced by its precomputed _BcastRef input."""
+        from ..exec.joins import TpuBroadcastHashJoinExec
+
         if isinstance(node, (_LeafRef, _StageRef)):
             out.append(node)
         elif isinstance(node, tuple):
+            if cut_broadcast and isinstance(node[0],
+                                            TpuBroadcastHashJoinExec):
+                self._collect_refs(node[1], out, cut_broadcast)
+                out.append(_BcastRef(node[0]))
+                return
             for k in node[1:]:
-                self._collect_refs(k, out)
+                self._collect_refs(k, out, cut_broadcast)
 
-    def _collect_aux_keys(self, node, out: List[str]):
+    def _collect_aux_keys(self, node, out: List[str],
+                          cut_broadcast=False):
         """Keys of capacity-checked collectives in this stage: joins
         (static output capacity) and capped exchanges (per-destination
-        tile capacity)."""
+        tile capacity).  With ``cut_broadcast``, broadcast build
+        subtrees are skipped (their collectives run in the precompute
+        program, not this stage's)."""
         from ..exec.exchange import TpuShuffleExchangeExec
         from ..exec.joins import (TpuBroadcastHashJoinExec,
                                   TpuHashJoinExec)
@@ -667,24 +705,19 @@ class DistributedRunner:
         from ..shuffle.partitioning import SinglePartitioning
 
         if isinstance(node, tuple):
+            if cut_broadcast and isinstance(node[0],
+                                            TpuBroadcastHashJoinExec):
+                out.append(f"join{id(node[0])}")
+                self._collect_aux_keys(node[1], out, cut_broadcast)
+                return
             if isinstance(node[0], TpuHashJoinExec):
                 op = node[0]
                 out.append(f"join{id(op)}")
-                if not isinstance(op, TpuBroadcastHashJoinExec):
-                    # mirror the repair-exchange decision in _lower
-                    lpart = self._source_partitioning(node[1])
-                    rpart = self._source_partitioning(node[2])
-                    keys_ok = (
-                        self._hash_keys_match(lpart, op.plan.left_keys)
-                        and self._hash_keys_match(rpart,
-                                                  op.plan.right_keys))
-                    single_ok = (self._is_single(lpart)
-                                 and self._is_single(rpart))
-                    if not (keys_ok or single_ok) and (
-                            self._range_keys(lpart) is not None
-                            or self._range_keys(rpart) is not None):
-                        out.append(f"jexl{id(op)}")
-                        out.append(f"jexr{id(op)}")
+                if not isinstance(op, TpuBroadcastHashJoinExec) and \
+                        self._join_colocation(
+                            op, node[1], node[2]) == "repair":
+                    out.append(f"jexl{id(op)}")
+                    out.append(f"jexr{id(op)}")
             if isinstance(node[0], TpuShuffleExchangeExec) and \
                     not isinstance(node[0].partitioning,
                                    SinglePartitioning):
@@ -693,23 +726,36 @@ class DistributedRunner:
                     not self._sort_presorted(node[1], node[0]):
                 out.append(f"rexch{id(node[0])}")
             for k in node[1:]:
-                self._collect_aux_keys(k, out)
+                self._collect_aux_keys(k, out, cut_broadcast)
 
-    def _run_stage(self, stage: _Stage, env_stacked: Dict,
-                   caps: Dict) -> DeviceBatch:
-        """jit + shard_map one stage; returns the stacked output batch.
-        Retries with doubled join capacity on overflow."""
+    def _collect_broadcasts(self, node, out: List):
+        """Broadcast joins of this stage in post-order (inner builds
+        first, so an outer build side can consume an inner's env key)."""
+        from ..exec.joins import TpuBroadcastHashJoinExec
+
+        if isinstance(node, tuple):
+            for k in node[1:]:
+                self._collect_broadcasts(k, out)
+            if isinstance(node[0], TpuBroadcastHashJoinExec):
+                out.append((node[0], node[2]))
+
+    def _run_program(self, root, env_stacked: Dict, caps: Dict,
+                     post=None) -> DeviceBatch:
+        """jit + shard_map the lowering of ``root``; retries with grown
+        capacities on collective overflow.  ``post`` (traced hook) runs
+        on the per-shard output before unstacking — the broadcast
+        precompute passes the replicate here."""
         import jax
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         refs: List = []
-        self._collect_refs(stage.root, refs)
+        self._collect_refs(root, refs, cut_broadcast=True)
         in_keys = [self._env_key(r) for r in refs]
         ins = [env_stacked[k] for k in in_keys]
 
         aux_keys: List[str] = []
-        self._collect_aux_keys(stage.root, aux_keys)
+        self._collect_aux_keys(root, aux_keys, cut_broadcast=True)
         aux_keys = sorted(aux_keys)
 
         for _attempt in range(_MAX_JOIN_RETRIES):
@@ -719,7 +765,9 @@ class DistributedRunner:
                 env = {k: X.squeeze_leading(b)
                        for k, b in zip(in_keys, stacked)}
                 aux: Dict = {}
-                out = self._lower(stage.root, env, aux, caps, used_caps)
+                out = self._lower(root, env, aux, caps, used_caps)
+                if post is not None:
+                    out = post(out)
                 # aux (capacity demands) replicated via pmax so EVERY
                 # controller process reads the same overflow verdict and
                 # takes the same retry path (multi-process SPMD needs
@@ -741,8 +789,33 @@ class DistributedRunner:
                     caps[k] = bucket_rows(total, self.min_bucket)
                     overflow = True
             if not overflow:
-                return self._retile(out)
+                return out
         raise RuntimeError("collective capacity retries exhausted")
+
+    def _prepare_broadcasts(self, stage: _Stage, env_stacked: Dict,
+                            caps: Dict) -> None:
+        """Gather each broadcast build side ONCE per query, as its own
+        compiled program, so stage capacity retries and repeated stage
+        executions reuse the replicated batch instead of re-running the
+        all_gather (reference: one broadcast relation per exchange,
+        GpuBroadcastExchangeExec.scala:215-247)."""
+        ops: List = []
+        self._collect_broadcasts(stage.root, ops)
+        for op, build_kid in ops:
+            key = f"bcast{id(op)}"
+            if key in env_stacked:
+                continue
+            env_stacked[key] = self._run_program(
+                build_kid, env_stacked, caps,
+                post=self.transport.replicate)
+
+    def _run_stage(self, stage: _Stage, env_stacked: Dict,
+                   caps: Dict) -> DeviceBatch:
+        """jit + shard_map one stage; returns the stacked output batch.
+        Retries with doubled join capacity on overflow."""
+        self._prepare_broadcasts(stage, env_stacked, caps)
+        return self._retile(
+            self._run_program(stage.root, env_stacked, caps))
 
     def _retile(self, stacked: DeviceBatch) -> DeviceBatch:
         """Host-side bucket trim between stages: shapes grow through

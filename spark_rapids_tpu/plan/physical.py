@@ -46,6 +46,9 @@ class ExecContext:
         self.conf = conf
         self.session = session
         self.metrics = MetricsRegistry()
+        #: shuffle ids registered during this query, freed at query end
+        #: (reference: per-shuffle cleanup, ShuffleBufferCatalog.scala)
+        self.shuffle_ids: List[int] = []
 
 
 class PartitionedData:

@@ -23,6 +23,13 @@ from .plan.physical import ExecContext, PhysicalPlan, collect_batches
 from .plan.planner import Planner
 
 
+def _clear_registry_quietly(registry):
+    try:
+        registry.clear()
+    except Exception:  # noqa: BLE001 - interpreter teardown
+        pass
+
+
 class Session:
     """User entry point.
 
@@ -58,9 +65,26 @@ class Session:
             self.device_manager = DeviceManager.get_or_create(self.conf)
             self.spill_framework = install_spill(self.device_manager,
                                                  self.conf)
+            # reusable broadcast artifacts (reference:
+            # GpuBroadcastExchangeExec's broadcast variable, built once
+            # and shared by every consumer)
+            from .exec.broadcast import BroadcastRegistry
+            from .shuffle.catalog import ShuffleCatalog
+
+            self.broadcast_registry = BroadcastRegistry(
+                self.spill_framework)
+            weakref.finalize(self, _clear_registry_quietly,
+                             self.broadcast_registry)
+            # shuffle-id -> map-id -> buffers index with per-shuffle
+            # cleanup (reference: ShuffleBufferCatalog.scala)
+            self.shuffle_catalog = ShuffleCatalog(self.spill_framework)
+            weakref.finalize(self, _clear_registry_quietly,
+                             self.shuffle_catalog)
         else:
             self.device_manager = None
             self.spill_framework = None
+            self.broadcast_registry = None
+            self.shuffle_catalog = None
         Session._active = self
 
     # ----- data sources ----------------------------------------------------
@@ -152,6 +176,11 @@ class Session:
             return collect_batches(data, schema, ctx)
         finally:
             phys._exec_lock.release()
+            # per-shuffle cleanup at query end — frees shuffle output
+            # even when a reader abandoned early (limit over a join)
+            if self.shuffle_catalog is not None:
+                for sid in ctx.shuffle_ids:
+                    self.shuffle_catalog.unregister_shuffle(sid)
 
     def execute_columnar(self, plan: L.LogicalPlan):
         """Zero-copy device export: returns the list of DeviceBatches of

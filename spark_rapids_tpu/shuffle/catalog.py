@@ -1,0 +1,67 @@
+"""Shuffle buffer catalog.
+
+Reference analogue: ShuffleBufferCatalog.scala — a
+shuffle-id -> map-id -> buffers index layered over the spill-buffer
+catalog, with per-shuffle cleanup so a query's shuffle data is freed
+even when a reader abandons early (a ``limit(1)`` over a shuffled
+join), and RapidsShuffleInternalManager.scala:230-250's
+unregister-on-shuffle-end.  Buffer payloads live in the spill
+framework; this index owns only ids and their grouping.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+
+class ShuffleCatalog:
+    def __init__(self, fw):
+        self._fw = fw
+        self._lock = threading.Lock()
+        self._next = itertools.count()
+        #: shuffle id -> map id -> [spill buffer ids]
+        self._shuffles: Dict[int, Dict[int, List[int]]] = {}
+
+    # ----- write side -------------------------------------------------
+    def register_shuffle(self) -> int:
+        with self._lock:
+            sid = next(self._next)
+            self._shuffles[sid] = {}
+            return sid
+
+    def add_buffer(self, shuffle_id: int, map_id: int,
+                   buf_id: int) -> None:
+        with self._lock:
+            maps = self._shuffles.get(shuffle_id)
+            if maps is None:  # already unregistered: free immediately
+                self._fw.remove_batch(buf_id)
+                return
+            maps.setdefault(map_id, []).append(buf_id)
+
+    # ----- read side --------------------------------------------------
+    def buffers_of(self, shuffle_id: int,
+                   map_id: Optional[int] = None) -> List[int]:
+        with self._lock:
+            maps = self._shuffles.get(shuffle_id, {})
+            if map_id is not None:
+                return list(maps.get(map_id, ()))
+            return [b for bs in maps.values() for b in bs]
+
+    def active_shuffles(self) -> List[int]:
+        with self._lock:
+            return list(self._shuffles)
+
+    # ----- cleanup ----------------------------------------------------
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        """Free every buffer of one shuffle (idempotent)."""
+        with self._lock:
+            maps = self._shuffles.pop(shuffle_id, None)
+        if maps:
+            for bufs in maps.values():
+                for b in bufs:
+                    self._fw.remove_batch(b)
+
+    def clear(self) -> None:
+        for sid in self.active_shuffles():
+            self.unregister_shuffle(sid)

@@ -72,3 +72,30 @@ def test_hash_partition_placement_matches_host():
     dev_h = hashing.hash_device_batch([db.columns[0]])
     dev_ids = np.asarray(hashing.pmod(dev_h, 8))[:hb.num_rows]
     np.testing.assert_array_equal(host_ids, dev_ids)
+
+
+def test_per_shuffle_cleanup_on_abandoned_reader():
+    """limit(1) over a shuffled join abandons the exchange readers
+    early; query-end per-shuffle cleanup must still free every shuffle
+    buffer (reference: ShuffleBufferCatalog per-shuffle cleanup +
+    RapidsShuffleInternalManager.scala:230-250 unregister)."""
+    import gc
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    sess = srt.Session(
+        {"spark.rapids.tpu.sql.broadcastSizeThreshold": 0})
+    fw = SpillFramework.get()
+    base_ids = set(fw.catalog.ids())
+    l = sess.create_dataframe(
+        {"k": list(range(300)), "v": list(range(300))})
+    r = sess.create_dataframe(
+        {"rk": list(range(300)), "w": list(range(300))})
+    rows = l.join(r, on=(["k"], ["rk"]), how="inner").limit(1).collect()
+    assert len(rows) == 1
+    # the query-end unregister ran (not just the GC backstop)
+    assert sess.shuffle_catalog.active_shuffles() == []
+    gc.collect()
+    leftover = set(fw.catalog.ids()) - base_ids
+    assert not leftover, f"orphaned spill buffers: {leftover}"

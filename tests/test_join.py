@@ -123,3 +123,48 @@ def test_empty_sides():
             return l.join(r, on="k", how="left")
 
         _run_both(build, how_assert_on_tpu=False)
+
+
+def test_broadcast_artifact_reused_across_collects():
+    """The broadcast build side is materialized ONCE and shared across
+    repeated collects of the same plan; the artifact dies with the plan
+    (reference: GpuBroadcastExchangeExec.scala:215-247 builds the
+    broadcast relation once and Spark caches it)."""
+    import gc
+
+    from spark_rapids_tpu.exec.joins import TpuBroadcastHashJoinExec
+
+    sess = srt.Session()
+    l = sess.create_dataframe(
+        {"k": list(range(100)), "v": list(range(100))})
+    r = sess.create_dataframe(
+        {"rk": list(range(0, 100, 2)), "w": list(range(50))})
+    j = l.join(r, on=(["k"], ["rk"]), how="inner")
+
+    phys, _ctx = sess.prepare_execution(j.plan)
+    phys._exec_lock.release()
+    found = []
+
+    def walk(n):
+        if isinstance(n, TpuBroadcastHashJoinExec):
+            found.append(n)
+        for c in getattr(n, "children", []):
+            walk(c)
+
+    walk(phys)
+    assert found, "small build side must plan as a broadcast join"
+
+    reg = sess.broadcast_registry
+    base = reg.builds
+    a = _norm(j.collect())
+    b = _norm(j.collect())
+    assert a == b and len(a) == 50
+    assert reg.builds == base + 1, \
+        "build side must materialize exactly once across collects"
+    assert len(reg) >= 1
+
+    # plan dropped -> artifact purged (no session-lifetime leak)
+    del j, phys, found
+    gc.collect()
+    reg._purge_dead()
+    assert len(reg) == 0
