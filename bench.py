@@ -36,6 +36,13 @@ import os
 import sys
 import time
 
+# persistent XLA compile cache: over the remote-TPU tunnel a cold q1
+# warmup alone costs minutes of compiles; the cache survives processes
+# so the measurement budget goes to measuring
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(
+                          os.path.abspath(__file__)), ".jax_cache"))
+
 SF = 0.05
 QUERY_TABLES = {
     1: ["lineitem"],
@@ -315,6 +322,10 @@ def _q1_pipeline_mrows():
     n_rows = 1 << 20
     fn, example = build_q1_pipeline(n_rows=n_rows, seed=0)
     jfn = jax.jit(fn)
+    # keep the operands device-resident: re-uploading host args every
+    # iteration measures the tunnel, not the kernel
+    example = jax.device_put(example)
+    jax.block_until_ready(example)
     jfn(example).block_until_ready()
 
     def run():
@@ -335,6 +346,14 @@ def main():
         platform = "cpu-fallback"
     else:
         _emit({"progress": "backend_probe", "platform": platform})
+
+    try:
+        import jax
+
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:  # noqa: BLE001 - older jax: default threshold
+        pass
 
     from spark_rapids_tpu.benchmarks import tpch
     from spark_rapids_tpu.benchmarks.tpch_datagen import generate

@@ -28,13 +28,17 @@ def concat_device_batches(batches: List[DeviceBatch],
                           min_bucket: int = 128) -> DeviceBatch:
     """Concatenate device batches row-wise into one bucketed batch
     (reference: ConcatAndConsumeAll / Table.concatenate)."""
+    import jax
     import jax.numpy as jnp
 
     assert batches
     if len(batches) == 1:
         return batches[0]
     schema = batches[0].schema
-    counts = [int(b.num_rows) for b in batches]
+    # one batched readback — per-batch int(num_rows) is a device RTT
+    # each, ruinous over a remote-TPU link
+    counts = [int(n) for n in
+              jax.device_get([b.num_rows for b in batches])]
     total = sum(counts)
     padded = bucket_rows(total, min_bucket)
     cols: List[DeviceColumn] = []

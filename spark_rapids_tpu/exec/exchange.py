@@ -138,6 +138,16 @@ class TpuShuffleExchangeExec(TpuExec):
                     range_key_passes(b, self.partitioning._bound_keys),
                     bounds))
             self._bounds_pid_kernel = jax.jit(range_pids_from_bounds)
+            import jax.numpy as jnp
+
+            def _sample(passes, nr):
+                idx = (jnp.arange(RANGE_SAMPLES_PER_BATCH,
+                                  dtype=jnp.int32)
+                       * jnp.maximum(nr, 1)
+                       ) // RANGE_SAMPLES_PER_BATCH
+                return passes[:, idx]
+
+            self._sample_kernel = jax.jit(_sample)
 
     @property
     def schema(self):
@@ -209,34 +219,67 @@ class TpuShuffleExchangeExec(TpuExec):
         fw = SpillFramework.get()
 
         def _drain_child():
+            import jax
+
+            import jax.numpy as jnp
+
             items = []  # (buffer id, round-robin start offset)
             rr = 0
-            samples = []   # device key samples for the range bounds
+            samples = []   # host key samples for the range bounds
             pending = []   # (buf_id, id(batch), passes) for pid prefill
             # passes are unspillable HBM; cap what the prefill may pin
             # so a long shuffle write can't defeat the spill framework
             # (batches past the cap recompute pids at first read)
             pend_budget = 64 * 1024 * 1024
+            # chunk entries hold NO batch reference — only the buffer
+            # id plus tiny device handles (count scalar, sample tile) —
+            # so a spill of a chunk member actually frees its HBM
+            chunk = []  # (buf_id, num_rows handle, sample handle|None)
+
+            def flush():
+                # ONE batched readback of the chunk's row counts and
+                # range samples — a per-batch int(num_rows) is a full
+                # device RTT each, which dominates shuffle writes on a
+                # remote-TPU link
+                nonlocal rr
+                if not chunk:
+                    return
+                got = jax.device_get([(nr, samp)
+                                      for _b, nr, samp in chunk])
+                for (buf_id, _nr, _s), (n, samp) in zip(chunk, got):
+                    n = int(n)
+                    if n == 0:
+                        fw.remove_batch(buf_id)
+                        continue
+                    if samp is not None:
+                        samples.append(np.asarray(samp))
+                    items.append((buf_id, rr))
+                    rr = (rr + n) % self.n_out
+                chunk.clear()
+
             with trace_range("TpuShuffleWrite",
                              self.metrics[M.TOTAL_TIME]):
                 for pid in range(child.n_partitions):
                     for b in child.iterator(pid):
-                        n = int(b.num_rows)
-                        if n == 0:
-                            continue
-                        if is_range:
-                            passes = self._passes_kernel(b)
-                            s = min(n, RANGE_SAMPLES_PER_BATCH)
-                            idx = (np.arange(s) * n) // s
-                            samples.append(np.asarray(passes[:, idx]))
                         buf_id = fw.add_batch(b)
                         if catalog is not None:
                             catalog.add_buffer(shuffle_id, pid, buf_id)
-                        if is_range and pend_budget > 0:
-                            pending.append((buf_id, id(b), passes))
-                            pend_budget -= passes.size * 8
-                        items.append((buf_id, rr))
-                        rr = (rr + n) % self.n_out
+                        samp = None
+                        if is_range:
+                            passes = self._passes_kernel(b)
+                            nr = jnp.asarray(b.num_rows,
+                                             dtype=jnp.int32)
+                            samp = self._sample_kernel(passes, nr)
+                            if pend_budget > 0:
+                                pending.append((buf_id, id(b), passes))
+                                pend_budget -= passes.size * 8
+                        chunk.append((buf_id,
+                                      jnp.asarray(b.num_rows,
+                                                  dtype=jnp.int32),
+                                      samp))
+                        if len(chunk) >= 32:
+                            flush()
+                flush()
             if is_range and samples:
                 import jax.numpy as jnp
 
@@ -309,18 +352,35 @@ class TpuShuffleExchangeExec(TpuExec):
 
         def make(p):
             def it():
+                import jax
                 import jax.numpy as jnp
+
+                # chunked streaming: one count sync per K slices (vs a
+                # device RTT per (partition, batch) pair) WITHOUT
+                # materializing the whole partition's slices at once —
+                # at most K unspillable slice batches are live
+                outs = []
+
+                def drain_outs():
+                    counts = jax.device_get([o.num_rows for o in outs])
+                    for out, n in zip(outs, counts):
+                        if int(n):
+                            self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                            yield out
+                    outs.clear()
 
                 for buf_id, rr_start in materialized():
                     b = fw.acquire_batch(buf_id)
                     try:
-                        out = self._slice_kernel(
-                            b, pids_of(buf_id, b, rr_start), jnp.int32(p))
+                        outs.append(self._slice_kernel(
+                            b, pids_of(buf_id, b, rr_start),
+                            jnp.int32(p)))
                     finally:
                         fw.release_batch(buf_id)
-                    if int(out.num_rows):
-                        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
-                        yield out
+                    if len(outs) >= 8:
+                        yield from drain_outs()
+                if outs:
+                    yield from drain_outs()
 
             return it
 

@@ -10,14 +10,15 @@ kernels; the TPU formulation is scan-based over one global sort:
   * count/sum/avg over ANY rows frame become two gathers into an
     exclusive prefix sum,
   * min/max use segment-reset associative scans (unbounded ends) or a
-    statically-unrolled shifted reduction (bounded frames — frame
-    offsets are plan constants, so the width is a compile-time
-    constant),
+    sparse-table doubling query (bounded frames — O(log width) levels,
+    any width),
   * row_number/rank/dense_rank are index arithmetic on segment starts.
 
+  * first/last over frames are index gathers: the frame edge row
+    directly, or (ignoreNulls) a next/previous-valid-index scan.
+
 Everything for all window expressions traces into ONE jitted program.
-Falls back to the host engine for string-typed frame aggregates,
-first/last over windows, and bounded frames wider than _MAX_WIDTH.
+Falls back to the host engine for string-typed frame aggregates.
 """
 from __future__ import annotations
 
@@ -25,7 +26,8 @@ from typing import List
 
 from .. import types as T
 from ..data.column import DeviceBatch, DeviceColumn
-from ..ops.aggregates import AggregateFunction, Average, Count, Sum
+from ..ops.aggregates import (AggregateFunction, Average, Count, First,
+                              Last, Sum)
 from ..ops.expression import as_device_column
 from ..ops.kernels import gather as G
 from ..ops.kernels import segment as seg
@@ -34,8 +36,6 @@ from ..ops.windowexprs import (DenseRank, Rank, RowNumber,
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
 from .base import DevicePartitionedData, RequireSingleBatch, TpuExec
-
-_MAX_WIDTH = 256  # bounded-frame unroll cap; wider frames fall back
 
 
 def _supported_reason(wx: WindowExpression):
@@ -46,18 +46,16 @@ def _supported_reason(wx: WindowExpression):
         return None
     if not isinstance(func, AggregateFunction):
         return f"window function {type(func).__name__} not on device"
+    if isinstance(func, (First, Last)):
+        if func.child is not None and func.child.dtype.is_string:
+            return "string window aggregates run on the host engine"
+        return None
     name = getattr(func, "name", type(func).__name__.lower())
     if isinstance(func, (Count, Sum, Average)) or name in ("min", "max"):
         child = func.child
         if child is not None and child.dtype.id is T.TypeId.STRING \
                 and name in ("min", "max", "sum", "average", "avg"):
             return "string window aggregates run on the host engine"
-        f = wx.spec.resolved_frame()
-        if f.lower is not None and f.upper is not None \
-                and name in ("min", "max") \
-                and (f.upper - f.lower + 1) > _MAX_WIDTH:
-            return (f"bounded min/max frame wider than {_MAX_WIDTH} "
-                    f"runs on the host engine")
         return None
     return f"window aggregate {name} runs on the host engine"
 
@@ -208,6 +206,36 @@ class TpuWindowExec(TpuExec):
         cntP = jnp.concatenate([jnp.zeros((1,), jnp.int64),
                                 jnp.cumsum(valid.astype(jnp.int64))])
         cnt = cntP[hi] - cntP[lo]
+        if isinstance(func, (First, Last)):
+            # index gathers on the frame edges (reference: cudf
+            # rolling nth_element; here the sorted layout makes first =
+            # row at lo, last = row at hi-1, and ignoreNulls the
+            # next/previous VALID index via an associative scan)
+            idx64 = jnp.arange(n, dtype=jnp.int64)
+            nonempty = lo < hi
+            if isinstance(func, First):
+                if func.ignore_nulls:
+                    cand = jnp.where(valid, idx64, jnp.int64(n))
+                    nxt = jax.lax.associative_scan(jnp.minimum, cand,
+                                                   reverse=True)
+                    j = nxt[jnp.clip(lo, 0, n - 1)]
+                    ok = nonempty & (j < hi)
+                else:
+                    j = lo.astype(jnp.int64)
+                    ok = nonempty
+            else:
+                if func.ignore_nulls:
+                    cand = jnp.where(valid, idx64, jnp.int64(-1))
+                    prv = jax.lax.associative_scan(jnp.maximum, cand)
+                    j = prv[jnp.clip(hi - 1, 0, n - 1)]
+                    ok = nonempty & (j >= lo)
+                else:
+                    j = (hi - 1).astype(jnp.int64)
+                    ok = nonempty
+            jc = jnp.clip(j, 0, n - 1).astype(jnp.int32)
+            out = vals[jc]
+            out_valid = ok if func.ignore_nulls else ok & valid[jc]
+            return out, out_valid
         if isinstance(func, Count):
             return cnt, jnp.ones((n,), dtype=jnp.bool_)
         if isinstance(func, (Sum, Average)):
@@ -243,13 +271,32 @@ class TpuWindowExec(TpuExec):
             run = _seg_scan(comb, masked, seg_ids, reverse=True)
             out = run[jnp.clip(lo, 0, n - 1)]               # [i, end)
             return out, cnt > 0
-        # bounded both: static unroll over the frame width
-        out = jnp.full((n,), ident, vals.dtype)
-        for d in range(frame.lower, frame.upper + 1):
-            j = i32 + d
-            ok = (j >= lo) & (j < hi)
-            v = masked[jnp.clip(j, 0, n - 1)]
-            out = comb(out, jnp.where(ok, v, ident))
+        # bounded both: sparse-table (doubling) range min/max — O(log w)
+        # levels instead of a width-long unroll, so ANY frame width
+        # compiles (the old _MAX_WIDTH=256 unroll cap is gone).
+        # m_k[i] = comb over [i, i+2^k); query [lo, hi) = comb of the
+        # two overlapping power-of-two windows at the edges.
+        width = frame.upper - frame.lower + 1
+        # clamp by the row count: ln <= n, so levels past
+        # bit_length(n) can never be selected
+        n_levels = max(1, int(min(width, n)).bit_length())
+        levels = [masked]
+        for k in range(1, n_levels):
+            prev = levels[-1]
+            sh = 1 << (k - 1)
+            shifted = jnp.concatenate(
+                [prev[sh:], jnp.full((sh,), ident, vals.dtype)])
+            levels.append(comb(prev, shifted))
+        table = jnp.stack(levels)                       # [L, n]
+        ln = (hi - lo).astype(jnp.int64)
+        # floor(log2(ln)) — exact: x64 float log2 is exact for ints
+        lvl = jnp.floor(jnp.log2(jnp.maximum(ln, 1).astype(
+            jnp.float64))).astype(jnp.int32)
+        lvl = jnp.clip(lvl, 0, n_levels - 1)
+        two_l = (jnp.int64(1) << lvl.astype(jnp.int64)).astype(jnp.int32)
+        a = table[lvl, jnp.clip(lo, 0, n - 1)]
+        b = table[lvl, jnp.clip(hi - two_l, 0, n - 1)]
+        out = jnp.where(ln > 0, comb(a, b), ident)
         return out, cnt > 0
 
     # ------------------------------------------------------------------

@@ -143,6 +143,18 @@ def _compute_sorted(batch, wx, order, seg_ids, seg_starts, n):
         vv = v[ok] if vdtype.id is T.TypeId.STRING else v[ok]
         if isinstance(func, Count):
             out[i] = len(vv)
+        elif func.name in ("first", "last") and not getattr(
+                func, "ignore_nulls", True):
+            # Spark default (ignoreNulls=false): the frame-edge ROW's
+            # value, null included
+            if len(v) == 0:
+                out_valid[i] = False
+            else:
+                j = 0 if func.name == "first" else -1
+                if ok[j]:
+                    out[i] = v[j]
+                else:
+                    out_valid[i] = False
         elif len(vv) == 0:
             out_valid[i] = False
         elif isinstance(func, Sum):

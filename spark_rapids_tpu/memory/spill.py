@@ -91,7 +91,10 @@ class SpillableBuffer:
         from ..native import serializer
 
         assert self.tier == StorageTier.DEVICE
-        pf = serializer.PreparedFrame(device_to_host(self._device))
+        # trim=False: the trim allocates device buffers, and this runs
+        # exactly when the device is out of memory
+        pf = serializer.PreparedFrame(device_to_host(self._device,
+                                                     trim=False))
         frame = None
         if arena is not None:
             off = arena.alloc(pf.size)

@@ -199,8 +199,9 @@ def stack_partitions(batches: List[DeviceBatch]) -> DeviceBatch:
         lengths = (jnp.stack([b.columns[i].lengths for b in batches])
                    if c0.lengths is not None else None)
         cols.append(DeviceColumn(c0.dtype, data, validity, lengths))
-    num_rows = jnp.asarray([int(b.num_rows) for b in batches],
-                           dtype=jnp.int32)
+    num_rows = jnp.asarray(
+        [jnp.asarray(b.num_rows, dtype=jnp.int32) for b in batches],
+        dtype=jnp.int32)
     return DeviceBatch(b0.schema, cols, num_rows)
 
 

@@ -106,3 +106,50 @@ def test_string_window_agg_falls_back():
     cq = cpu.create_dataframe(data).with_window(
         "w", over(f.min("s"), window().partition_by("k")))
     assert _norm(q.collect()) == _norm(cq.collect())
+
+
+@pytest.mark.parametrize("which", ["first", "last"])
+@pytest.mark.parametrize("ignore_nulls", [False, True],
+                         ids=["keep_nulls", "ignore_nulls"])
+@pytest.mark.parametrize("frame", ["running", "unbounded", "bounded"],
+                         ids=["running", "unbounded", "bounded"])
+def test_first_last_window_on_device(which, ignore_nulls, frame):
+    """first/last over windows run on device via frame-edge index
+    gathers (previously a host fallback — VERDICT r3 row 21)."""
+    fn = getattr(f, which)
+
+    def build():
+        w = window().partition_by("k").order_by("t")
+        if frame == "unbounded":
+            w = w.rows_between(None, None)
+        elif frame == "bounded":
+            w = w.rows_between(-1, 1)
+        return over(fn("v", ignore_nulls=ignore_nulls), w)
+
+    _run_both(build)
+
+
+def test_first_last_string_falls_back():
+    data = {"k": [1, 1, 2], "t": [1, 2, 3], "s": ["a", None, "c"]}
+    _run_both(lambda: over(
+        f.first("s"), window().partition_by("k").order_by("t")),
+        expect_tpu=False, data=data)
+
+
+def test_wide_bounded_minmax_on_device():
+    """Bounded min/max frames of ANY width run on device via the
+    sparse-table doubling query (the old 256-wide unroll cap fell back
+    to the host)."""
+    rng = np.random.RandomState(4)
+    n = 3000
+    data = {"k": (rng.randint(0, 3, n)).tolist(),
+            "t": list(range(n)),
+            "v": [float(x) if x > 5 else None
+                  for x in rng.randint(0, 100, n)]}
+    for lo, hi in [(-700, 0), (-400, 400), (3, 900)]:
+        _run_both(lambda lo=lo, hi=hi: over(
+            f.min("v"), window().partition_by("k").order_by("t")
+            .rows_between(lo, hi)), data=data)
+        _run_both(lambda lo=lo, hi=hi: over(
+            f.max("v"), window().partition_by("k").order_by("t")
+            .rows_between(lo, hi)), data=data)
