@@ -156,6 +156,10 @@ CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
 TASK_THREADS = conf("spark.rapids.tpu.sql.taskThreads").doc(
     "Host task-runner threads per process (partition-level data "
     "parallelism)").int_conf(8)
+TASK_RETRIES = conf("spark.rapids.tpu.sql.taskRetries").doc(
+    "Times a failed partition task is re-executed from its lineage "
+    "before the query fails (the engine's analogue of Spark task "
+    "rescheduling; 0 disables)").int_conf(1)
 
 # --- batch sizing (:289-309) ---------------------------------------------
 BATCH_SIZE_BYTES = conf("spark.rapids.tpu.sql.batchSizeBytes").doc(

@@ -213,6 +213,40 @@ def locate(bm, lengths, needle: bytes, start_pos: int = 1):
     return jnp.where(any_, first + 1, 0)
 
 
+def substring_index(bm, lengths, delim: bytes, count: int):
+    """Spark ``substring_index`` for a SINGLE-BYTE delimiter (cannot
+    self-overlap, so every match is a split point — exact vs
+    str.split).  count>0: prefix before the count-th delimiter;
+    count<0: suffix after the |count|-th-from-the-right; too few
+    delimiters -> the whole string."""
+    jnp = _jnp()
+    n, w = bm.shape
+    if count == 0:
+        return jnp.zeros_like(bm), jnp.zeros_like(lengths)
+    match = _find(bm, lengths, delim)
+    cum = jnp.cumsum(match.astype(jnp.int32), axis=1)
+    total = cum[:, -1] if w else jnp.zeros((n,), jnp.int32)
+    if count > 0:
+        has = total >= count
+        hit = (cum == count) & match
+        cut = jnp.argmax(hit, axis=1).astype(jnp.int32)
+        new_len = jnp.where(has, cut, lengths)
+        return _masked(bm, new_len), new_len
+    k = -count
+    has = total >= k
+    target = total - k + 1
+    hit = (cum == target[:, None]) & match
+    start = jnp.where(has,
+                      jnp.argmax(hit, axis=1).astype(jnp.int32)
+                      + len(delim), 0)
+    new_len = (lengths - start).astype(jnp.int32)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    src = jnp.clip(start[:, None] + pos, 0, max(w - 1, 0))
+    g = jnp.take_along_axis(bm, src, axis=1)
+    keep = pos < new_len[:, None]
+    return jnp.where(keep, g, 0).astype(jnp.uint8), new_len
+
+
 def trim_ws(bm, lengths, out_w: int, left: bool = True, right: bool = True):
     """Trim spaces (0x20) from either end."""
     jnp = _jnp()

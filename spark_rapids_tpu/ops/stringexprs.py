@@ -220,6 +220,21 @@ class SubstringIndex(Expression):
 
         return HostColumn(T.STRING, _host_str_map(c, fn), c.validity)
 
+    def eval_tpu(self, batch):
+        c = as_device_column(self.children[0].eval_tpu(batch),
+                             batch.padded_rows)
+        bm, ln = sk.substring_index(
+            c.data, c.lengths, self.delim.encode("utf-8"), self.count)
+        return DeviceColumn(T.STRING, bm, c.validity, ln)
+
+    @property
+    def tpu_supported(self):
+        # single-byte delimiters cannot self-overlap, so the device
+        # match-count kernel is exact vs str.split; multi-byte
+        # delimiters stay on host
+        return len(self.delim.encode("utf-8")) == 1 and \
+            self.children[0].tpu_supported
+
 
 class StringReplace(Expression):
     def __init__(self, child, search: str, replace: str):

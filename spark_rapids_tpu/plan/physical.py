@@ -14,6 +14,7 @@ pipeline breakers that materialize through the shuffle layer.
 """
 from __future__ import annotations
 
+import logging
 import math
 from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Sequence
@@ -37,6 +38,8 @@ from ..ops.kernels import segment as seg
 from ..utils import hashing
 from ..utils.metrics import MetricsRegistry
 from . import functions as F
+
+log = logging.getLogger(__name__)
 
 
 class ExecContext:
@@ -78,24 +81,48 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
     tasks/GPU guidance in docs/tuning-guide.md:85-100)."""
     n = data.n_partitions
     threads = 1
-    if ctx is not None and n > 1:
-        from ..config import TASK_THREADS
+    retries = 0
+    sem = None
+    if ctx is not None:
+        from ..config import TASK_RETRIES, TASK_THREADS
 
-        threads = min(ctx.conf.get(TASK_THREADS), n)
-    if threads <= 1:
-        batches = []
-        for pid in range(n):
-            batches.extend(data.iterator(pid))
-    else:
-        from concurrent.futures import ThreadPoolExecutor
-
-        sem = None
+        retries = max(0, ctx.conf.get(TASK_RETRIES))
+        if n > 1:
+            threads = min(ctx.conf.get(TASK_THREADS), n)
         if ctx.session is not None and ctx.session.device_manager:
             sem = ctx.session.device_manager.semaphore
 
-        def run_task(pid: int):
+    def drain_with_retry(pid: int):
+        """One 'task': drain a partition, retrying on failure
+        (reference: Spark reschedules a failed task — the engine's
+        iterators rebuild their pipeline state on re-call, so a
+        transient failure re-executes the partition's lineage; the
+        shuffle client's FetchRetry plays the same role,
+        RapidsShuffleClient.scala:378)."""
+        for attempt in range(retries + 1):
             try:
                 return list(data.iterator(pid))
+            except Exception:
+                if sem is not None:
+                    sem.release_all()  # drop a failed task's permits
+                if attempt == retries:
+                    raise
+                log.warning("task for partition %d failed "
+                            "(attempt %d/%d) — retrying",
+                            pid, attempt + 1, retries + 1,
+                            exc_info=True)
+        raise AssertionError("retry loop must return or raise")
+
+    if threads <= 1:
+        batches = []
+        for pid in range(n):
+            batches.extend(drain_with_retry(pid))
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def run_task(pid: int):
+            try:
+                return drain_with_retry(pid)
             finally:
                 if sem is not None:
                     sem.release_all()

@@ -216,3 +216,45 @@ def test_release_all_drops_reentrant_hold():
     ok = sem._sem.acquire(timeout=1)
     assert ok
     sem._sem.release()
+
+
+@pytest.mark.parametrize("threads", [1, 4])
+def test_task_retry_reexecutes_failed_partition(threads):
+    """A transiently failing partition task is re-run from its lineage
+    instead of failing the query (reference: Spark task rescheduling;
+    FetchRetry in RapidsShuffleClient.scala:378).  VERDICT r3 row 61."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.data.column import HostBatch
+    from spark_rapids_tpu.plan.physical import (ExecContext,
+                                                PartitionedData,
+                                                collect_batches)
+    from spark_rapids_tpu.session import Session
+
+    sess = Session({"spark.rapids.tpu.sql.taskThreads": threads})
+    schema = T.Schema([T.Field("x", T.INT64)])
+    fails = {"left": 1}
+
+    def good(pid):
+        def it():
+            yield HostBatch.from_pydict({"x": [pid * 10, pid * 10 + 1]},
+                                        schema)
+        return it
+
+    def flaky(pid):
+        def it():
+            if fails["left"] > 0:
+                fails["left"] -= 1
+                raise RuntimeError("transient task failure")
+            yield HostBatch.from_pydict({"x": [99]}, schema)
+        return it
+
+    data = PartitionedData([good(0), flaky(1), good(2)])
+    out = collect_batches(data, schema,
+                          ExecContext(sess.conf, sess))
+    assert sorted(out.column("x").to_pylist()) == [0, 1, 20, 21, 99]
+
+    # retries exhausted -> the failure propagates
+    fails["left"] = 10
+    with pytest.raises(RuntimeError):
+        collect_batches(PartitionedData([good(0), flaky(1)]), schema,
+                        ExecContext(sess.conf, sess))

@@ -226,3 +226,25 @@ def test_like_underscore_falls_back(strict_tpu_session):
     df = strict_tpu_session.create_dataframe({"s": ["ab", "ax"]})
     with pytest.raises(AssertionError):
         df.select(df["s"].like("a_").alias("m")).collect()
+
+
+@pytest.mark.parametrize("count", [1, 2, 3, -1, -2, 0])
+def test_substring_index_device(count):
+    data = {"s": ["a.b.c.d", "nodot", ".lead", "trail.", "..", "",
+                  "x.y", None]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            f.substring_index(df["s"], ".", count).alias("m")), data)
+
+
+def test_substring_index_single_byte_stays_on_device(strict_tpu_session):
+    df = strict_tpu_session.create_dataframe({"s": ["a.b.c", "q"]})
+    out = df.select(f.substring_index(df["s"], ".", 2).alias("m")).collect()
+    assert [r[0] for r in out] == ["a.b", "q"]
+
+
+def test_substring_index_multibyte_falls_back(strict_tpu_session):
+    # multi-byte delimiter -> host path; strict mode must raise
+    df = strict_tpu_session.create_dataframe({"s": ["a--b--c"]})
+    with pytest.raises(AssertionError):
+        df.select(f.substring_index(df["s"], "--", 1).alias("m")).collect()
