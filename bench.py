@@ -43,7 +43,7 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(os.path.dirname(
                           os.path.abspath(__file__)), ".jax_cache"))
 
-SF = 0.05
+SF = float(os.environ.get("SRT_BENCH_SF", "0.1"))
 QUERY_TABLES = {
     1: ["lineitem"],
     3: ["customer", "orders", "lineitem"],

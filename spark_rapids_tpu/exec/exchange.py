@@ -297,12 +297,21 @@ class TpuShuffleExchangeExec(TpuExec):
         def materialized():
             """Shuffle write: batches registered as spillable in the
             device store (reference: RapidsCachingWriter keeps map
-            output in HBM, spillable under pressure)."""
-            if done.is_set():
-                if state["error"] is not None:
-                    raise state["error"]
+            output in HBM, spillable under pressure).  A FAILED write
+            re-arms the election instead of caching the error forever,
+            so a task-level retry (collect_batches) re-executes the
+            write from lineage — without this, taskRetries would be a
+            no-op below any exchange."""
+            if done.is_set() and state["error"] is None:
                 return store[0]
             with elect_lock:
+                if done.is_set():
+                    if state["error"] is None:
+                        return store[0]
+                    # failed write: reset so THIS task re-drains
+                    state["error"] = None
+                    state["writer"] = False
+                    done.clear()
                 i_write = not state["writer"]
                 state["writer"] = True
             if i_write:

@@ -247,6 +247,35 @@ def substring_index(bm, lengths, delim: bytes, count: int):
     return jnp.where(keep, g, 0).astype(jnp.uint8), new_len
 
 
+def replace_single(bm, lengths, search: bytes, replace: bytes):
+    """Replace every occurrence of a SINGLE search byte with ``replace``
+    (any length, including empty = delete).  A single byte cannot
+    self-overlap, so match positions are exactly str.replace's
+    non-overlapping scan.  Output width grows to w*len(replace) worst
+    case; built by scatter with a dump slot for masked writes."""
+    jnp = _jnp()
+    n, w = bm.shape
+    k = len(replace)
+    m = _masked(bm, lengths)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_str = pos < lengths[:, None]
+    match = (m == search[0]) & in_str
+    mi = match.astype(jnp.int32)
+    excl = jnp.cumsum(mi, axis=1) - mi      # matches strictly before j
+    o = pos + (k - 1) * excl                # output offset of byte j
+    out_w = max(w * max(k, 1), 1)
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    out = jnp.zeros((n, out_w + 1), dtype=jnp.uint8)  # +1 dump slot
+    copy_idx = jnp.where(in_str & ~match, o, out_w)
+    out = out.at[rows, copy_idx].set(
+        jnp.where(in_str & ~match, m, 0).astype(jnp.uint8))
+    for t in range(k):
+        idx_t = jnp.where(match, o + t, out_w)
+        out = out.at[rows, idx_t].set(jnp.uint8(replace[t]))
+    new_len = (lengths + (k - 1) * mi.sum(axis=1)).astype(jnp.int32)
+    return out[:, :out_w], new_len
+
+
 def trim_ws(bm, lengths, out_w: int, left: bool = True, right: bool = True):
     """Trim spaces (0x20) from either end."""
     jnp = _jnp()

@@ -253,6 +253,21 @@ class StringReplace(Expression):
             _host_str_map(c, lambda s: s.replace(self.search, self.replace)),
             c.validity)
 
+    def eval_tpu(self, batch):
+        c = as_device_column(self.children[0].eval_tpu(batch),
+                             batch.padded_rows)
+        bm, ln = sk.replace_single(c.data, c.lengths,
+                                   self.search.encode("utf-8"),
+                                   self.replace.encode("utf-8"))
+        return DeviceColumn(T.STRING, bm, c.validity, ln)
+
+    @property
+    def tpu_supported(self):
+        # a single search byte cannot self-overlap -> exact on device;
+        # longer patterns stay on host
+        return len(self.search.encode("utf-8")) == 1 and \
+            self.children[0].tpu_supported
+
 
 class _NeedlePredicate(Expression):
     """contains/startswith/endswith with literal needle."""

@@ -95,13 +95,21 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
     def drain_with_retry(pid: int):
         """One 'task': drain a partition, retrying on failure
         (reference: Spark reschedules a failed task — the engine's
-        iterators rebuild their pipeline state on re-call, so a
-        transient failure re-executes the partition's lineage; the
-        shuffle client's FetchRetry plays the same role,
-        RapidsShuffleClient.scala:378)."""
+        iterators rebuild their pipeline state on re-call, and a failed
+        shuffle write re-arms its election, so a transient failure
+        re-executes the partition's lineage; the shuffle client's
+        FetchRetry plays the same role, RapidsShuffleClient.scala:378).
+        AssertionError is deterministic (strict-test-mode fallbacks,
+        invariant checks) and is never retried.  Known divergence:
+        batches emitted before the failure already counted in operator
+        metrics, so a retried partition inflates NUM_OUTPUT_* — the
+        same eager-accumulator behavior query metrics have under any
+        partially-consumed iterator."""
         for attempt in range(retries + 1):
             try:
                 return list(data.iterator(pid))
+            except AssertionError:
+                raise
             except Exception:
                 if sem is not None:
                     sem.release_all()  # drop a failed task's permits

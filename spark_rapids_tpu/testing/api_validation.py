@@ -42,8 +42,8 @@ INTENTIONAL_HOST_EXPRS = {
     "RegExpReplace",          # full regex: host fallback by design
     # (Like lowers %-only patterns on device; `_` patterns fall back
     # per-instance via tpu_supported)
-    "StringReplace",          # variable-width rewrite on host
-    # (SubstringIndex lowers single-byte delimiters on device)
+    # (Like lowers %-only patterns; SubstringIndex/StringReplace lower
+    # single-byte delimiters/needles; the rest fall back per-instance)
     "UnixTimestampParse", "FromUnixTime",  # strftime parse/format on host
     "InputFileName", "InputFileBlockStart",
     "InputFileBlockLength",   # scan-context intrinsics, host metadata

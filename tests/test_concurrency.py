@@ -258,3 +258,30 @@ def test_task_retry_reexecutes_failed_partition(threads):
     with pytest.raises(RuntimeError):
         collect_batches(PartitionedData([good(0), flaky(1)]), schema,
                         ExecContext(sess.conf, sess))
+
+
+def test_task_retry_through_exchange(monkeypatch):
+    """A transient failure during the shuffle WRITE must be retryable:
+    the failed write re-arms its election so the task-level retry
+    re-executes the exchange from lineage (reference: FetchRetry +
+    Spark task rescheduling)."""
+    import spark_rapids_tpu.exec.transitions as tr
+    from spark_rapids_tpu import Session, f
+    from spark_rapids_tpu.data import column as dc
+
+    orig = dc.host_to_device
+    state = {"fails": 1}
+
+    def flaky(hb, *a, **k):
+        if state["fails"]:
+            state["fails"] -= 1
+            raise RuntimeError("transient upload failure")
+        return orig(hb, *a, **k)
+
+    monkeypatch.setattr(tr, "host_to_device", flaky)
+    sess = Session()
+    df = sess.create_dataframe({"k": [1, 1, 2, 2, 3],
+                                "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    got = sorted(df.group_by("k").agg(f.sum("v").alias("s")).collect())
+    assert got == [(1, 3.0), (2, 7.0), (3, 5.0)]
+    assert state["fails"] == 0, "the injected failure never fired"

@@ -248,3 +248,23 @@ def test_substring_index_multibyte_falls_back(strict_tpu_session):
     df = strict_tpu_session.create_dataframe({"s": ["a--b--c"]})
     with pytest.raises(AssertionError):
         df.select(f.substring_index(df["s"], "--", 1).alias("m")).collect()
+
+
+@pytest.mark.parametrize("search,repl", [
+    (".", "::"),   # grow
+    ("-", ""),     # delete
+    ("a", "b"),    # same width
+    ("z", "xyz"),  # absent needle
+])
+def test_string_replace_device(search, repl):
+    data = {"s": ["a.b.c", "-a-", "....", "", "no match here",
+                  "trail.", None, "aaa"]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            f.replace(df["s"], search, repl).alias("m")), data)
+
+
+def test_string_replace_multibyte_falls_back(strict_tpu_session):
+    df = strict_tpu_session.create_dataframe({"s": ["abab"]})
+    with pytest.raises(AssertionError):
+        df.select(f.replace(df["s"], "ab", "x").alias("m")).collect()
