@@ -257,29 +257,41 @@ class TpuShuffleExchangeExec(TpuExec):
                     rr = (rr + n) % self.n_out
                 chunk.clear()
 
-            with trace_range("TpuShuffleWrite",
-                             self.metrics[M.TOTAL_TIME]):
-                for pid in range(child.n_partitions):
-                    for b in child.iterator(pid):
-                        buf_id = fw.add_batch(b)
-                        if catalog is not None:
-                            catalog.add_buffer(shuffle_id, pid, buf_id)
-                        samp = None
-                        if is_range:
-                            passes = self._passes_kernel(b)
-                            nr = jnp.asarray(b.num_rows,
-                                             dtype=jnp.int32)
-                            samp = self._sample_kernel(passes, nr)
-                            if pend_budget > 0:
-                                pending.append((buf_id, id(b), passes))
-                                pend_budget -= passes.size * 8
-                        chunk.append((buf_id,
-                                      jnp.asarray(b.num_rows,
-                                                  dtype=jnp.int32),
-                                      samp))
-                        if len(chunk) >= 32:
-                            flush()
-                flush()
+            added = []  # every buffer this ATTEMPT registered
+            try:
+                with trace_range("TpuShuffleWrite",
+                                 self.metrics[M.TOTAL_TIME]):
+                    for pid in range(child.n_partitions):
+                        for b in child.iterator(pid):
+                            buf_id = fw.add_batch(b)
+                            added.append(buf_id)
+                            if catalog is not None:
+                                catalog.add_buffer(shuffle_id, pid,
+                                                   buf_id)
+                            samp = None
+                            if is_range:
+                                passes = self._passes_kernel(b)
+                                nr = jnp.asarray(b.num_rows,
+                                                 dtype=jnp.int32)
+                                samp = self._sample_kernel(passes, nr)
+                                if pend_budget > 0:
+                                    pending.append((buf_id, id(b),
+                                                    passes))
+                                    pend_budget -= passes.size * 8
+                            chunk.append((buf_id,
+                                          jnp.asarray(b.num_rows,
+                                                      dtype=jnp.int32),
+                                          samp))
+                            if len(chunk) >= 32:
+                                flush()
+                    flush()
+            except BaseException:
+                # a failed attempt must not leave its partial map
+                # output resident until query end — the re-armed retry
+                # registers a full fresh set
+                for bid in added:
+                    fw.remove_batch(bid)
+                raise
             if is_range and samples:
                 import jax.numpy as jnp
 
@@ -302,12 +314,15 @@ class TpuShuffleExchangeExec(TpuExec):
             so a task-level retry (collect_batches) re-executes the
             write from lineage — without this, taskRetries would be a
             no-op below any exchange."""
-            if done.is_set() and state["error"] is None:
+            # `store` is appended ONLY on success and success is
+            # permanent — gating on it is race-free, unlike reading the
+            # done/error pair outside the lock
+            if store:
                 return store[0]
             with elect_lock:
+                if store:
+                    return store[0]
                 if done.is_set():
-                    if state["error"] is None:
-                        return store[0]
                     # failed write: reset so THIS task re-drains
                     state["error"] = None
                     state["writer"] = False
@@ -333,7 +348,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 if sem is not None:
                     sem.release_all()
                 done.wait()
-                if state["error"] is not None:
+                if not store:
                     raise RuntimeError(
                         "shuffle write failed in peer task"
                     ) from state["error"]

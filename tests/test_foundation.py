@@ -153,3 +153,32 @@ def test_conf_registry_and_docs():
     assert conf.is_sql_enabled
     md = dump_markdown()
     assert "spark.rapids.tpu.sql.enabled" in md
+
+
+def test_packed_upload_roundtrip():
+    """host_to_device packs every array into ONE transfer; the unpack
+    (slice + bitcast) must be byte-exact for every dtype family."""
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.data.column import (HostBatch, device_to_host,
+                                              host_to_device,
+                                              packed_upload)
+    import jax
+
+    probe = [np.asarray([-1, 0, 2**62], dtype=np.int64),
+             np.asarray([1.5, -0.0, float("nan")], dtype=np.float64),
+             np.asarray([True, False]),
+             np.arange(9, dtype=np.uint8).reshape(3, 3),
+             np.asarray([7, -7], dtype=np.int32),
+             np.asarray([-1, -128, 127], dtype=np.int8)]
+    got = jax.device_get(packed_upload(probe))
+    for a, o in zip(probe, got):
+        np.testing.assert_array_equal(a, np.asarray(o))
+
+    hb = HostBatch.from_pydict({
+        "i": [1, None, 3], "f": [0.5, 2.5, None],
+        "s": ["ab", None, "xyz"], "b": [True, False, None],
+    })
+    rt = device_to_host(host_to_device(hb))
+    assert rt.to_rows() == hb.to_rows()
