@@ -355,8 +355,12 @@ class DeviceBatch:
 # silently falls back to per-array uploads if it does not hold
 # (SRT_PACKED_UPLOAD=0 forces the fallback).
 # --------------------------------------------------------------------------
+#: "auto" = pack on accelerators only (the win is transfer round
+#: trips; on the CPU backend the extra memcpy is pure overhead);
+#: "1"/"0" force on/off
 _PACK_STATE = {
-    "enabled": os.environ.get("SRT_PACKED_UPLOAD", "1") != "0",
+    "mode": os.environ.get("SRT_PACKED_UPLOAD", "auto"),
+    "enabled": True,
     "verified": False,
 }
 _UNPACK_CACHE: dict = {}
@@ -425,6 +429,12 @@ def _packing_ok() -> bool:
     byte order must match numpy's little-endian layout)."""
     if _PACK_STATE["verified"]:
         return _PACK_STATE["enabled"]
+    if _PACK_STATE["mode"] == "0":
+        _PACK_STATE["enabled"] = False
+    elif _PACK_STATE["mode"] == "auto":
+        import jax
+
+        _PACK_STATE["enabled"] = jax.default_backend() != "cpu"
     if _PACK_STATE["enabled"]:
         try:
             import jax

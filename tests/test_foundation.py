@@ -180,5 +180,14 @@ def test_packed_upload_roundtrip():
         "i": [1, None, 3], "f": [0.5, 2.5, None],
         "s": ["ab", None, "xyz"], "b": [True, False, None],
     })
-    rt = device_to_host(host_to_device(hb))
+    # force the packed path (auto mode disables it on the CPU backend)
+    from spark_rapids_tpu.data import column as dcol
+
+    old = dict(dcol._PACK_STATE)
+    dcol._PACK_STATE.update({"mode": "1", "enabled": True,
+                             "verified": False})
+    try:
+        rt = device_to_host(host_to_device(hb))
+    finally:
+        dcol._PACK_STATE.update(old)
     assert rt.to_rows() == hb.to_rows()
