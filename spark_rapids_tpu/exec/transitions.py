@@ -38,9 +38,26 @@ def _split_host_batch(batch, max_rows: int, max_bytes: int):
         yield batch.slice(start, min(start + rows_cap, n))
 
 
+def _free_cached_uploads(fw, store):
+    for entries in store.values():
+        for buf_id, _n in entries:
+            try:
+                fw.remove_batch(buf_id)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
+
+
 class HostToDeviceExec(TpuExec):
     """Upload host batches to device HBM (GpuRowToColumnarExec /
-    HostColumnarToGpu analogue)."""
+    HostColumnarToGpu analogue).
+
+    Uploads of IMMUTABLE in-memory sources (LocalScanExec) are cached
+    as spill-registered device batches, so repeated collects of the
+    same plan skip the encode+transfer entirely — the analogue of the
+    reference keeping hot tables device-resident via the device store.
+    Only fully-drained partitions are published (a limit() that
+    abandons a partition early must not cache a partial read); file
+    scans are never cached (files can change on disk)."""
 
     def __init__(self, child):
         super().__init__([child])
@@ -62,6 +79,25 @@ class HostToDeviceExec(TpuExec):
         max_bytes = ctx.conf.get(READER_BATCH_SIZE_BYTES)
         prefetch = ctx.conf.get(READER_PREFETCH_BATCHES)
 
+        fw = store = None
+        from ..plan.physical import LocalScanExec
+
+        if isinstance(self.children[0], LocalScanExec) \
+                and ctx.session is not None \
+                and ctx.session.spill_framework is not None:
+            import weakref
+
+            fw = ctx.session.spill_framework
+            key = (min_rows, max_rows, max_bytes)
+            caches = getattr(self, "_upload_caches", None)
+            if caches is None:
+                caches = self._upload_caches = {}
+            store = caches.get(key)
+            if store is None:
+                # pid -> [(buf id, row count)], complete drains only
+                store = caches[key] = {}
+                weakref.finalize(self, _free_cached_uploads, fw, store)
+
         def upload(hb):
             if sem:
                 sem.acquire_if_necessary()
@@ -73,6 +109,44 @@ class HostToDeviceExec(TpuExec):
             return db
 
         def make(pid):
+            def it_cached():
+                for buf_id, n_rows in store[pid]:
+                    if sem:
+                        sem.acquire_if_necessary()
+                    b = fw.acquire_batch(buf_id)  # promotes if spilled
+                    fw.release_batch(buf_id)
+                    self.metrics[M.NUM_OUTPUT_ROWS].add(n_rows)
+                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                    yield b
+
+            def it_recording(inner):
+                # each batch registers with the spill framework AS IT
+                # STREAMS (an unregistered accumulation would pin the
+                # whole partition in HBM, invisible to the spiller);
+                # only a fully-drained partition publishes its entries
+                import jax
+
+                ids = []
+                nrs = []
+                complete = False
+                try:
+                    for db in inner:
+                        ids.append(fw.add_batch(db))
+                        nrs.append(db.num_rows)
+                        yield db
+                    complete = True
+                finally:
+                    if complete and pid not in store:
+                        counts = [int(n) for n in jax.device_get(nrs)] \
+                            if nrs else []
+                        entries = list(zip(ids, counts))
+                        if store.setdefault(pid, entries) is not entries:
+                            for i in ids:  # lost a publish race
+                                fw.remove_batch(i)
+                    elif not complete:
+                        for i in ids:  # abandoned drain (limit)
+                            fw.remove_batch(i)
+
             def it_inline():
                 for batch in child_data.iterator(pid):
                     for hb in _split_host_batch(batch, max_rows,
@@ -139,7 +213,15 @@ class HostToDeviceExec(TpuExec):
                 finally:
                     stop.set()
 
-            return it_pipelined if prefetch > 0 else it_inline
+            def it():
+                if store is not None and pid in store:
+                    return it_cached()
+                inner = it_pipelined() if prefetch > 0 else it_inline()
+                if store is not None:
+                    return it_recording(inner)
+                return inner
+
+            return it
 
         return DevicePartitionedData(
             [make(i) for i in range(child_data.n_partitions)])

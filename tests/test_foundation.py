@@ -191,3 +191,36 @@ def test_packed_upload_roundtrip():
     finally:
         dcol._PACK_STATE.update(old)
     assert rt.to_rows() == hb.to_rows()
+
+
+def test_local_scan_upload_cache(monkeypatch):
+    """Repeated collects of the same plan reuse the cached device
+    upload of an immutable in-memory source; a partially-drained
+    partition (limit) is never cached."""
+    import spark_rapids_tpu.exec.transitions as tr
+    from spark_rapids_tpu import Session, f
+    from spark_rapids_tpu.data import column as dc
+
+    calls = {"n": 0}
+    orig = dc.host_to_device
+
+    def counting(hb, *a, **k):
+        calls["n"] += 1
+        return orig(hb, *a, **k)
+
+    monkeypatch.setattr(tr, "host_to_device", counting)
+    sess = Session()
+    df = sess.create_dataframe(
+        {"k": list(range(100)), "v": [float(i) for i in range(100)]})
+    # a limit abandons its read early -> partial partitions must NOT
+    # be published to the cache
+    lim = df.select("k").limit(1).collect()
+    assert len(lim) == 1
+    q = df.group_by("k").agg(f.sum("v").alias("s"))
+    a = sorted(q.collect())
+    first = calls["n"]
+    assert first > 0
+    b = sorted(q.collect())
+    assert a == b
+    assert calls["n"] == first, \
+        "second collect must not re-upload the cached source"
