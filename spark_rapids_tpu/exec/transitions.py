@@ -110,14 +110,25 @@ class HostToDeviceExec(TpuExec):
 
         def make(pid):
             def it_cached():
-                for buf_id, n_rows in store[pid]:
-                    if sem:
-                        sem.acquire_if_necessary()
-                    b = fw.acquire_batch(buf_id)  # promotes if spilled
-                    fw.release_batch(buf_id)
-                    self.metrics[M.NUM_OUTPUT_ROWS].add(n_rows)
-                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
-                    yield b
+                # the pin is held while the CONSUMER uses the batch
+                # (released when the next one is acquired), so the
+                # spiller can never evict an in-use buffer and
+                # undercount real HBM
+                held = None
+                try:
+                    for buf_id, n_rows in store[pid]:
+                        if sem:
+                            sem.acquire_if_necessary()
+                        b = fw.acquire_batch(buf_id)  # promote if spilled
+                        if held is not None:
+                            fw.release_batch(held)
+                        held = buf_id
+                        self.metrics[M.NUM_OUTPUT_ROWS].add(n_rows)
+                        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                        yield b
+                finally:
+                    if held is not None:
+                        fw.release_batch(held)
 
             def it_recording(inner):
                 # each batch registers with the spill framework AS IT
@@ -136,14 +147,16 @@ class HostToDeviceExec(TpuExec):
                         yield db
                     complete = True
                 finally:
-                    if complete and pid not in store:
+                    if complete:
                         counts = [int(n) for n in jax.device_get(nrs)] \
                             if nrs else []
                         entries = list(zip(ids, counts))
                         if store.setdefault(pid, entries) is not entries:
-                            for i in ids:  # lost a publish race
+                            # someone else published first (concurrent
+                            # drain of the same partition): drop ours
+                            for i in ids:
                                 fw.remove_batch(i)
-                    elif not complete:
+                    else:
                         for i in ids:  # abandoned drain (limit)
                             fw.remove_batch(i)
 

@@ -303,14 +303,33 @@ def lexsort_device(key_cols: List[DeviceColumn],
     import jax.numpy as jnp
 
     n = key_cols[0].data.shape[0] if key_cols else pad_valid.shape[0]
-    order = jnp.arange(n, dtype=jnp.int32)
     passes = key_passes_device(key_cols, descending, nulls_first)
     if pad_valid is not None:
         passes.insert(0, jnp.where(pad_valid, jnp.uint64(0),
                                    jnp.uint64(2 ** 64 - 1)))
-    for k in reversed(passes):
-        order = order[jnp.argsort(k[order], stable=True)]
-    return order
+    return sort_permutation(passes, n)
+
+
+def sort_permutation(passes, n: int):
+    """int32 permutation ordering rows lexicographically by the uint64
+    ``passes`` (passes[0] dominates), stable.
+
+    One VARIADIC ``lax.sort`` call (num_keys = all passes) instead of a
+    per-pass argsort+gather chain: XLA sorts all key operands
+    lexicographically in a single kernel — one sorting-network launch
+    on TPU, one comparator sort on CPU, vs k of each before.  Payload
+    columns deliberately ride OUTSIDE the sort (gather by the returned
+    permutation): payload operands inside the comparator are ~3x
+    slower than sort+gather (measured on XLA CPU)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if not passes:
+        return jnp.arange(n, dtype=jnp.int32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    res = lax.sort(tuple(passes) + (iota,), dimension=0,
+                   is_stable=True, num_keys=len(passes))
+    return res[-1]
 
 
 def segment_ids_device(sorted_keys: List[DeviceColumn], pad_valid=None):

@@ -393,12 +393,10 @@ class DistributedRunner:
         n_samp = g.shape[1]
 
         # sort samples (invalid last) exactly like the lexsort
-        order = jnp.arange(n_samp, dtype=jnp.int32)
         sample_passes = [jnp.where(gv, jnp.uint64(0),
                                    jnp.uint64(2 ** 64 - 1))] + \
             [g[i] for i in range(g.shape[0])]
-        for k in reversed(sample_passes):
-            order = order[jnp.argsort(k[order], stable=True)]
+        order = seg.sort_permutation(sample_passes, n_samp)
 
         V = gv.sum()
         bpos = (V * jnp.arange(1, self.n)) // jnp.maximum(self.n, 1)
