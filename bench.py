@@ -316,6 +316,37 @@ def _q6_scan_breakdown(raw, iters=3):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _tpcxbb_mini(deadline):
+    """TPCx-BB mini-suite (the BASELINE north-star workload): four
+    representative queries — q1 (retail basket join+agg), q9 (gated
+    multi-predicate agg), q26 (clustering features), q30 (item
+    affinity self-join) — steady-state seconds each."""
+    from spark_rapids_tpu.benchmarks import tpcxbb, tpcxbb_datagen
+    from spark_rapids_tpu.session import Session
+
+    sess = Session(dict(PRESSURE_CONF))
+    tables = tpcxbb_datagen.dataframes(sess, sf=0.01, seed=99)
+    out = {}
+    for qn in (1, 9, 26, 30):
+        if time.perf_counter() > deadline:
+            break
+        df = tpcxbb.QUERIES[qn](tables)
+        best, _ = _best(lambda: df.collect(), iters=2, warmup=1,
+                        deadline=deadline)
+        out[f"q{qn}"] = round(best, 4)
+    if not out:
+        return None
+    if len(out) == 4:  # geomean only over the FULL set — a partial
+        # geomean silently drops the slow queries and reads as a win
+        prod = 1.0
+        for v in out.values():
+            prod *= max(v, 1e-6)
+        out["geomean_s"] = round(prod ** 0.25, 4)
+    else:
+        out["partial"] = True
+    return out
+
+
 def _q1_pipeline_mrows():
     import jax
 
@@ -438,6 +469,10 @@ def main():
     if q6_scan is not None:
         _emit({"progress": "q6_scan", **q6_scan})
     remaining = _deadline() - time.perf_counter()
+    tpcxbb_mini = _tpcxbb_mini(_deadline()) if remaining > 90 else None
+    if tpcxbb_mini is not None:
+        _emit({"progress": "tpcxbb_mini", **tpcxbb_mini})
+    remaining = _deadline() - time.perf_counter()
     q1p = _q1_pipeline_mrows() if remaining > 15 else None
 
     _emit({
@@ -455,6 +490,7 @@ def main():
         "per_query": per_query,
         "shuffle_write": shuffle,
         "q6_scan": q6_scan,
+        "tpcxbb_mini": tpcxbb_mini,
         "q1_pipeline": q1p,
     })
 
