@@ -49,7 +49,24 @@ def main():
     for g, w in zip(got, want):
         assert g[0] == w[0] and g[2] == w[2], (g, w)
         assert abs(g[1] - w[1]) < 1e-6 * max(1.0, abs(w[1])), (g, w)
-    print(f"MP RESULT OK pid={pid} rows={len(got)}", flush=True)
+
+    # global sort across processes: the sampled-bounds range exchange
+    # rides the cross-process collective; ORDER must survive the
+    # per-process gather (every controller sees the same total order)
+    def qs(s):
+        df = s.create_dataframe(dict(orders))
+        return df.sort(F.col("o_total").desc())
+
+    sorted_got = run_distributed_mp(sess, qs(sess), mesh).to_rows()
+    sorted_want = qs(cpu).collect()
+    assert len(sorted_got) == len(sorted_want)
+    for g, w in zip(sorted_got, sorted_want):
+        # whole rows, not just the key — a permutation bug that scrambles
+        # payload columns while ordering the key must fail here
+        assert g[0] == w[0], (g, w)
+        assert abs(g[1] - w[1]) < 1e-9, (g, w)
+    print(f"MP RESULT OK pid={pid} rows={len(got)} "
+          f"sorted={len(sorted_got)}", flush=True)
 
 
 if __name__ == "__main__":
