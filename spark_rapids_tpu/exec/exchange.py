@@ -52,10 +52,23 @@ RANGE_SAMPLES_PER_BATCH = 128
 def range_key_passes(batch: DeviceBatch, bound_keys):
     """Stacked order-preserving uint64 passes [n_passes, padded] of the
     range sort keys, with string keys truncated to RANGE_PREFIX_BYTES
-    (monotone coarsening — see module docstring)."""
+    (monotone coarsening — see module docstring).
+
+    No key AFTER the first string key contributes passes: a string may
+    be truncated by the prefix, and rows whose strings agree on the
+    prefix but differ beyond it would then be placed by the later key —
+    not a monotone coarsening of the true lexicographic order (a bound
+    landing inside the prefix-equal group would route rows against the
+    global order).  The cut is unconditional (not "only when this
+    batch's strings are wide") so the pass LAYOUT is static: bounds,
+    samples and the pid compare are shared across batches, and a
+    per-batch pass count would desync them.  Placement by the prefix
+    alone stays monotone — only balance suffers, and only for data
+    whose 32-byte prefixes collide."""
     import jax.numpy as jnp
 
     cols = []
+    used_keys = []
     for k in bound_keys:
         c = as_device_column(k.expr.eval_tpu(batch), batch.padded_rows)
         if c.dtype.is_string:
@@ -69,10 +82,13 @@ def range_key_passes(batch: DeviceBatch, bound_keys):
             c = DeviceColumn(c.dtype, bm, c.validity,
                              jnp.minimum(c.lengths, RANGE_PREFIX_BYTES))
         cols.append(c)
+        used_keys.append(k)
+        if c.dtype.is_string:
+            break
     passes = seg.key_passes_device(
         cols,
-        descending=[not k.ascending for k in bound_keys],
-        nulls_first=[k.nulls_first for k in bound_keys])
+        descending=[not k.ascending for k in used_keys],
+        nulls_first=[k.nulls_first for k in used_keys])
     return jnp.stack(passes)
 
 
@@ -300,10 +316,16 @@ class TpuShuffleExchangeExec(TpuExec):
                 state["bounds"] = bounds
                 # reuse the write-time key passes: pid prefill while the
                 # batches are still resident (a spilled+promoted batch
-                # misses on the id check and recomputes via the kernel)
+                # misses on the id check and recomputes via the kernel).
+                # Only for buffers that survived flush() — empty batches
+                # were removed there, and a pid entry for a dead buf_id
+                # would pin unspillable HBM forever (no spill listener
+                # ever fires for it).
+                live = {buf_id for buf_id, _rr in items}
                 for buf_id, bid, passes in pending:
-                    pid_cache[buf_id] = (
-                        bid, self._bounds_pid_kernel(passes, bounds))
+                    if buf_id in live:
+                        pid_cache[buf_id] = (
+                            bid, self._bounds_pid_kernel(passes, bounds))
             store.append(items)
 
         def materialized():

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from . import types as T
 from .config import EXPORT_COLUMNAR_RDD, TpuConf
 from .data.column import HostBatch
@@ -91,9 +93,22 @@ class Session:
     def create_dataframe(self, data, schema=None,
                          n_partitions: int = 2) -> DataFrame:
         """From a dict of name->values, a HostBatch, or list of row tuples
-        with a Schema."""
+        with a Schema.
+
+        Source data is treated as IMMUTABLE once handed in: repeated
+        collects may serve cached device uploads (HostToDeviceExec), so
+        mutating the source afterwards yields undefined results.  Dict
+        and row inputs are copied at creation; a HostBatch hands its
+        arrays over — they are frozen (``writeable=False``) so a later
+        caller write raises instead of silently serving stale cached
+        results.  (A column built over a VIEW can still be mutated
+        through the base array; the freeze is a tripwire, not a fence.)"""
         if isinstance(data, HostBatch):
             batch = data
+            for c in batch.columns:
+                for arr in (c.data, c.validity):
+                    if isinstance(arr, np.ndarray):
+                        arr.flags.writeable = False
         elif isinstance(data, dict):
             batch = HostBatch.from_pydict(data, schema)
         elif isinstance(data, list):

@@ -40,8 +40,6 @@ HOST_ONLY_EXECS = {
 INTENTIONAL_HOST_EXPRS = {
     "UnresolvedAttribute",    # always bound before evaluation
     "RegExpReplace",          # full regex: host fallback by design
-    # (Like lowers %-only patterns on device; `_` patterns fall back
-    # per-instance via tpu_supported)
     # (Like lowers %-only patterns; SubstringIndex/StringReplace lower
     # single-byte delimiters/needles; the rest fall back per-instance)
     "UnixTimestampParse", "FromUnixTime",  # strftime parse/format on host

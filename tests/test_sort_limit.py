@@ -66,6 +66,57 @@ def test_limit():
         _data(200, 9))
 
 
+def test_global_sort_long_string_prefix_collision():
+    # Strings wider than the range partitioner's 32-byte placement
+    # prefix, sharing that prefix, with a DIFFERENT secondary-key order
+    # than the post-prefix bytes: placement must ignore keys after the
+    # truncated string (prefix-only placement is monotone; including the
+    # secondary key routes prefix-equal rows against the global order).
+    import random
+
+    rng = random.Random(7)
+    prefix = "x" * 40  # every string collides on the 32-byte prefix
+    rows = []
+    for i in range(300):
+        tail = "%06d" % rng.randrange(1000)
+        rows.append((prefix + tail, rng.randrange(100), i))
+    data = {
+        "s": [r[0] for r in rows],
+        "k": [r[1] for r in rows],
+        "i": [r[2] for r in rows],
+    }
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.sort(df["s"], df["k"], df["i"]), data,
+        n_partitions=4)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.sort(df["s"].desc(), df["k"].desc(), df["i"]),
+        data, n_partitions=4)
+
+
+def test_global_sort_mixed_width_string_batches():
+    # Some input partitions hold only SHORT strings (batch byte matrix
+    # narrower than the placement prefix) while others hold long ones:
+    # the range partitioner's pass layout must be identical for every
+    # batch (bounds/samples are shared), i.e. the cut after a string
+    # key cannot depend on the batch's own matrix width.
+    import random
+
+    rng = random.Random(11)
+    short = ["a%02d" % rng.randrange(40) for _ in range(200)]
+    long_ = [("z" * 36) + "%04d" % rng.randrange(100)
+             for _ in range(200)]
+    # first half of the rows (the first input partitions) short, the
+    # rest long — chunked row->partition assignment keeps them apart
+    data = {
+        "s": short + long_,
+        "k": [rng.randrange(30) for _ in range(400)],
+        "i": list(range(400)),
+    }
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.sort(df["s"], df["k"], df["i"]), data,
+        n_partitions=4)
+
+
 def test_sort_on_device_plan_placement():
     from spark_rapids_tpu import Session
 
