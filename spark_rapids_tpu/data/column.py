@@ -533,6 +533,41 @@ def slice_device_batch(batch: DeviceBatch, start: int, stop: int,
     return DeviceBatch(batch.schema, cols, n)
 
 
+def pad_device_batch(batch: DeviceBatch, capacity: int,
+                     widths=None) -> DeviceBatch:
+    """Pad a device batch's row capacity (and, optionally, per-column
+    string byte-matrix widths: ``widths`` maps column index -> target
+    width) WITHOUT changing ``num_rows`` — shape unification so
+    independent executions of the same operator (e.g. grace-join bucket
+    pairs) share ONE compiled program instead of tracing per shape.
+    Padding rows stay outside ``row_mask()``; never shrinks."""
+    import jax.numpy as jnp
+
+    capacity = max(capacity, batch.padded_rows)
+    cols: List[DeviceColumn] = []
+    changed = False
+    for ci, c in enumerate(batch.columns):
+        data, validity, lengths = c.data, c.validity, c.lengths
+        extra = capacity - data.shape[0]
+        if c.lengths is not None:
+            w = max((widths or {}).get(ci, 0), data.shape[1])
+            if w > data.shape[1]:
+                data = jnp.pad(data, ((0, 0), (0, w - data.shape[1])))
+            if extra:
+                data = jnp.pad(data, ((0, extra), (0, 0)))
+                lengths = jnp.pad(lengths, (0, extra))
+        elif extra:
+            data = jnp.pad(data, (0, extra))
+        if extra:
+            validity = jnp.pad(validity, (0, extra))
+        if data is not c.data or validity is not c.validity:
+            changed = True
+        cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+    if not changed:
+        return batch
+    return DeviceBatch(batch.schema, cols, batch.num_rows)
+
+
 def device_to_host(batch: DeviceBatch, trim: bool = True) -> HostBatch:
     """Download a device batch in ONE batched transfer.
 

@@ -78,8 +78,10 @@ class TpuHashAggregateExec(TpuExec):
             lambda b: self._compute(b, "update", "buffers"))
         self._merge_kernel = jax.jit(
             lambda b: self._compute(b, "merge", "buffers"))
+        # only reached from _agg_chunked when mode is final/complete
+        # (partial returns the running buffers before finalize)
         self._merge_final_kernel = jax.jit(
-            lambda b: self._compute(b, "merge", emit))
+            lambda b: self._compute(b, "merge", "final"))
 
     def compute_batch(self, batch: DeviceBatch) -> DeviceBatch:
         """The mode's full aggregation over one batch (trace-safe; also

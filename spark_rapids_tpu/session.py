@@ -48,6 +48,8 @@ class Session:
         self.conf = TpuConf(settings)
         self._executed_plans: List[PhysicalPlan] = []
         self.capture_plans = False
+        self.last_metrics: Dict[str, int] = {}
+        self.last_write_stats = None  # WriteStatsTracker of last write
         # logical-plan -> physical-plan cache: repeated collect() of the
         # same DataFrame reuses the exec instances and with them every
         # per-exec jit cache (without this, each collect re-traced and
@@ -190,6 +192,9 @@ class Session:
             schema = phys.schema if len(phys.schema) else plan.schema
             return collect_batches(data, schema, ctx)
         finally:
+            # benchmark/debug hook: per-exec metric snapshot of the most
+            # recent execution (upload/readback wall decomposition)
+            self.last_metrics = ctx.metrics.snapshot()
             phys._exec_lock.release()
             # per-shuffle cleanup at query end — frees shuffle output
             # even when a reader abandoned early (limit over a join)

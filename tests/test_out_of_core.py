@@ -70,6 +70,22 @@ def test_chunked_groupby_strings():
         ), _data(3000, 7), ignore_order=True, conf=SMALL)
 
 
+def test_chunked_final_aggregate_high_cardinality():
+    """Near-unique keys make the partial outputs as big as the input,
+    so the FINAL aggregate's partitions arrive as multiple batches and
+    the chunked merge must finalize (regression: the final-mode merge
+    kernel referenced an undefined ``emit`` and NameError'd — no
+    low-cardinality test ever reached it)."""
+    data = dg.gen_batch({
+        "k": dg.IntGen(dg.T.INT64, min_val=0, max_val=1_000_000),
+        "v": dg.IntGen(dg.T.INT64, min_val=-1000, max_val=1000),
+    }, 4000, 11)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.group_by("k").agg(
+            f.sum(df["v"]).alias("sv"), f.count("*").alias("c")),
+        data, ignore_order=True, conf=SMALL)
+
+
 def test_chunked_global_agg():
     assert_tpu_and_cpu_are_equal_collect(
         lambda df: df.agg(f.sum(df["v"]).alias("sv"),
