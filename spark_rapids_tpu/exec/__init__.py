@@ -8,7 +8,7 @@ def register_rules(register_exec):
     import importlib
 
     for name in ("aggregate", "sort", "joins", "exchange", "window",
-                 "generate"):
+                 "generate", "write"):
         try:
             mod = importlib.import_module(f".{name}", __package__)
         except ModuleNotFoundError as e:

@@ -22,10 +22,13 @@ from . import arrow_convert as ac
 
 
 class WriteStatsTracker:
-    """Reference analogue: BasicColumnarWriteStatsTracker."""
+    """Reference analogue: BasicColumnarWriteStatsTracker — aggregate
+    counters plus a per-file rows/bytes report (``files``)."""
 
     def __init__(self):
         self.metrics = MetricsRegistry()
+        self.files: List[dict] = []
+        self._lock = __import__("threading").Lock()
 
     def new_file(self, path: str):
         self.metrics["numFiles"].add(1)
@@ -35,6 +38,11 @@ class WriteStatsTracker:
 
     def bytes_written(self, n: int):
         self.metrics["numOutputBytes"].add(n)
+
+    def file_done(self, path: str, rows: int, nbytes: int):
+        with self._lock:
+            self.files.append(
+                {"path": path, "rows": rows, "bytes": nbytes})
 
 
 def _write_one(batches: List[HostBatch], schema, fmt: str, path: str,
@@ -57,12 +65,17 @@ def _write_one(batches: List[HostBatch], schema, fmt: str, path: str,
     elif fmt == "orc":
         import pyarrow.orc as orc
 
-        orc.write_table(table, path)
+        kw = {}
+        if "stripe_size" in options:
+            kw["stripe_size"] = int(options["stripe_size"])
+        orc.write_table(table, path, **kw)
     else:
         raise ValueError(f"unsupported write format {fmt} "
                          "(reference also rejects CSV/JSON/text writes)")
     tracker.rows_written(table.num_rows)
-    tracker.bytes_written(os.path.getsize(path))
+    nbytes = os.path.getsize(path)
+    tracker.bytes_written(nbytes)
+    tracker.file_done(path, table.num_rows, nbytes)
 
 
 def write_partitions(data, schema, fmt: str, path: str, options: dict,
@@ -89,7 +102,12 @@ def write_partitions(data, schema, fmt: str, path: str, options: dict,
 def _write_dynamic(batches, schema, fmt, root, options, partition_by,
                    pid, ext, tracker):
     """Dynamic-partition writer (reference:
-    GpuFileFormatDataWriter.scala dynamic partition path)."""
+    GpuFileFormatDataWriter.scala dynamic partition path).  Values are
+    grouped by their DIRECTORY NAME (nulls -> sentinel, NaN -> 'nan',
+    specials escaped) so distinct float NaNs can't fan out into
+    same-path overwrites."""
+    from .scans import partition_dir_name
+
     batch = HostBatch.concat(batches) if len(batches) > 1 else batches[0]
     key_idx = [schema.index_of(k) for k in partition_by]
     keep_fields = [f for i, f in enumerate(schema.fields)
@@ -98,16 +116,17 @@ def _write_dynamic(batches, schema, fmt, root, options, partition_by,
     out_schema = T.Schema(keep_fields)
     keys = [batch.columns[i] for i in key_idx]
     n = batch.num_rows
-    tags = [tuple(c[i] for c in keys) for i in range(n)]
+
+    tags = [tuple(partition_dir_name(k, c[i])
+                  for k, c in zip(partition_by, keys))
+            for i in range(n)]
     uniq = {}
     for i, t in enumerate(tags):
         uniq.setdefault(t, []).append(i)
     for t, rows in uniq.items():
         sub = batch.take(np.asarray(rows, dtype=np.int64))
         sub = HostBatch(out_schema, [sub.columns[i] for i in keep_idx])
-        dirname = os.path.join(
-            root, *[f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
-                    for k, v in zip(partition_by, t)])
+        dirname = os.path.join(root, *t)
         os.makedirs(dirname, exist_ok=True)
         fname = os.path.join(dirname, f"part-{pid:05d}.{ext}")
         _write_one([sub], out_schema, fmt, fname, options, tracker)

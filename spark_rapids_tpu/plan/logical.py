@@ -274,12 +274,14 @@ class Window(LogicalPlan):
 class WriteFile(LogicalPlan):
     def __init__(self, child: LogicalPlan, fmt: str, path: str,
                  options: Optional[dict] = None,
-                 partition_by: Optional[List[str]] = None):
+                 partition_by: Optional[List[str]] = None,
+                 bucket_by: Optional[List[str]] = None):
         super().__init__([child])
         self.fmt = fmt
         self.path = path
         self.options = options or {}
         self.partition_by = partition_by or []
+        self.bucket_by = bucket_by or []
 
     @property
     def schema(self):
@@ -463,13 +465,15 @@ class DataFrame:
     def explain(self, mode: str = "ALL") -> str:
         return self.session.explain(self.plan, mode)
 
-    def write_parquet(self, path: str, partition_by=None, **options):
+    def write_parquet(self, path: str, partition_by=None,
+                      bucket_by=None, **options):
         self.session.execute(WriteFile(self.plan, "parquet", path,
-                                       options, partition_by))
+                                       options, partition_by, bucket_by))
 
-    def write_orc(self, path: str, partition_by=None, **options):
+    def write_orc(self, path: str, partition_by=None,
+                  bucket_by=None, **options):
         self.session.execute(WriteFile(self.plan, "orc", path,
-                                       options, partition_by))
+                                       options, partition_by, bucket_by))
 
     def __repr__(self):  # pragma: no cover
         return f"DataFrame[{', '.join(map(repr, self.schema.fields))}]"

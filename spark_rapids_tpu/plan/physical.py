@@ -912,15 +912,20 @@ class ShuffleExchangeExec(PhysicalPlan):
 # Write
 # ==========================================================================
 class DataWritingCommandExec(PhysicalPlan):
-    """Reference analogue: GpuDataWritingCommandExec."""
+    """Reference analogue: the host InsertIntoHadoopFsRelationCommand —
+    the rewrite engine tags it and converts supported writes to
+    TpuDataWritingCommandExec (exec/write.py), like
+    GpuOverrides.scala:1568-1580."""
 
     def __init__(self, child: PhysicalPlan, fmt: str, path: str,
-                 options: dict, partition_by: List[str]):
+                 options: dict, partition_by: List[str],
+                 bucket_by: Optional[List[str]] = None):
         super().__init__([child])
         self.fmt = fmt
         self.path = path
         self.options = options
         self.partition_by = partition_by
+        self.bucket_by = bucket_by or []
 
     @property
     def schema(self):
@@ -929,8 +934,13 @@ class DataWritingCommandExec(PhysicalPlan):
     def execute(self, ctx):
         from ..io import writers
 
+        if self.bucket_by:
+            raise NotImplementedError(
+                "bucketed writes are not supported")
         child = self.children[0].execute(ctx)
-        writers.write_partitions(child, self.children[0].schema, self.fmt,
-                                 self.path, self.options,
-                                 self.partition_by)
+        tracker = writers.write_partitions(
+            child, self.children[0].schema, self.fmt, self.path,
+            self.options, self.partition_by)
+        if ctx is not None and getattr(ctx, "session", None) is not None:
+            ctx.session.last_write_stats = tracker
         return PartitionedData([lambda: iter(())])

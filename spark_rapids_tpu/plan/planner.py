@@ -88,7 +88,7 @@ class Planner:
     def _plan_WriteFile(self, node: L.WriteFile):
         return P.DataWritingCommandExec(
             self.plan(node.children[0]), node.fmt, node.path, node.options,
-            node.partition_by)
+            node.partition_by, node.bucket_by)
 
     def _plan_Window(self, node: L.Window):
         from ..exec.window_cpu import WindowExec

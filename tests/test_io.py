@@ -93,6 +93,161 @@ def test_csv_read_options(tmp_path):
     assert len(got) == 2
 
 
+def test_hive_partition_read_roundtrip(tmp_path, mixed_df_data):
+    """Reading the ROOT of a partition_by tree returns the partition
+    column, derived from the key=value directory names (reference:
+    ColumnarPartitionReaderWithPartitionValues.scala:96) — the engine
+    can read back its own partitioned writes."""
+    sess = srt.Session()
+    cpu = srt.Session(tpu_enabled=False)
+    out = os.path.join(str(tmp_path), "hive")
+    sess.create_dataframe(mixed_df_data, _schema()).write_parquet(
+        out, partition_by=["k"])
+    back = sess.read_parquet(out)
+    # partition column appends after the file columns
+    assert back.schema.names == ["v", "s", "d", "k"]
+    got = back.collect()
+    exp = [(r[1], r[2], r[3], r[0]) for r in
+           cpu.create_dataframe(mixed_df_data, _schema()).collect()]
+    assert_rows_equal(exp, got, ignore_order=True,
+                      approximate_float=1e-9)
+    # and the partition column is queryable like any other
+    q = back.filter(back["k"] == 2).count()
+    assert q == sum(1 for r in exp if r[3] == 2)
+
+
+def test_hive_partition_string_and_null_values(tmp_path):
+    sess = srt.Session()
+    data = {"g": ["a", "b", None, "a"], "x": [1, 2, 3, 4]}
+    out = os.path.join(str(tmp_path), "hive2")
+    sess.create_dataframe(data).write_parquet(out, partition_by=["g"])
+    assert sorted(d for d in os.listdir(out) if "=" in d) == \
+        ["g=__HIVE_DEFAULT_PARTITION__", "g=a", "g=b"]
+    got = sorted(sess.read_parquet(out).collect())
+    assert got == [(1, "a"), (2, "b"), (3, None), (4, "a")]
+
+
+def test_hive_partition_nan_values_no_row_loss(tmp_path):
+    """NaN partition keys all map to one k=nan directory; the writer
+    must group them together instead of overwriting one file per NaN
+    row (regression: NaN != NaN split every NaN row into its own
+    same-path group)."""
+    sess = srt.Session()
+    data = {"g": [float("nan"), float("nan"), 1.0, float("nan")],
+            "x": [1, 2, 3, 4]}
+    out = os.path.join(str(tmp_path), "nan")
+    sess.create_dataframe(data).write_parquet(out, partition_by=["g"])
+    got = sess.read_parquet(out).collect()
+    assert len(got) == 4, got
+    assert sorted(x for x, _g in got) == [1, 2, 3, 4]
+    # host writer path too
+    cpu = srt.Session(tpu_enabled=False)
+    out2 = os.path.join(str(tmp_path), "nan2")
+    cpu.create_dataframe(data).write_parquet(out2, partition_by=["g"])
+    got2 = cpu.read_parquet(out2).collect()
+    assert len(got2) == 4
+
+
+def test_hive_partition_negative_zero_consistent(tmp_path):
+    """-0.0 and 0.0 partition keys land in ONE k=0.0 directory on both
+    engines (numerically equal values must not straddle group/name
+    boundaries — the device writer groups numerically, the host by
+    rendered name; partition_dir_name normalizes)."""
+    data = {"g": [0.0, -0.0, 1.5, -0.0], "x": [1, 2, 3, 4]}
+    for tpu in (True, False):
+        sess = srt.Session(tpu_enabled=tpu)
+        out = os.path.join(str(tmp_path), f"z{tpu}")
+        sess.create_dataframe(data).write_parquet(out,
+                                                  partition_by=["g"])
+        dirs = sorted(d for d in os.listdir(out) if "=" in d)
+        assert dirs == ["g=0.0", "g=1.5"], (tpu, dirs)
+        got = sorted(sess.read_parquet(out).collect())
+        assert [x for x, _g in got] == [1, 2, 3, 4], (tpu, got)
+
+
+def test_hive_partition_values_escaped(tmp_path):
+    """Partition values with path-special characters escape into the
+    directory name and unescape on read (reference:
+    ExternalCatalogUtils.escapePathName) — 'a/b' must not nest."""
+    sess = srt.Session()
+    data = {"g": ["a/b", "x=y", "plain", "a/b"], "x": [1, 2, 3, 4]}
+    out = os.path.join(str(tmp_path), "esc")
+    sess.create_dataframe(data).write_parquet(out, partition_by=["g"])
+    got = sorted(sess.read_parquet(out).collect())
+    assert got == [(1, "a/b"), (2, "x=y"), (3, "plain"), (4, "a/b")], got
+
+
+def test_write_goes_through_rewrite_engine(tmp_path, mixed_df_data):
+    """The write command is tagged/converted like any exec: '*' in
+    explain, '!' for bucketed output, device write under strict test
+    mode, per-file stats (reference: GpuOverrides.scala:1568-1580,
+    BasicColumnarWriteStatsTracker)."""
+    from spark_rapids_tpu.plan.logical import WriteFile
+
+    sess = srt.Session()
+    df = sess.create_dataframe(mixed_df_data, _schema())
+    ex = sess.explain(WriteFile(df.plan, "parquet", "/x", {}, ["k"]))
+    assert ex.splitlines()[0].startswith("* DataWritingCommandExec")
+    exb = sess.explain(WriteFile(df.plan, "parquet", "/x", {}, [],
+                                 ["k"]))
+    assert exb.splitlines()[0].startswith("! DataWritingCommandExec")
+    assert "bucketed" in exb.splitlines()[0]
+
+    strict = srt.Session({"spark.rapids.tpu.sql.test.enabled": True})
+    out = os.path.join(str(tmp_path), "strict")
+    strict.create_dataframe(mixed_df_data, _schema()).write_parquet(
+        out, partition_by=["k"])
+    st = strict.last_write_stats
+    assert st is not None
+    assert st.metrics["numOutputRows"].value == 500
+    assert st.files and all(f["rows"] > 0 and f["bytes"] > 0
+                            for f in st.files)
+    assert st.metrics["numFiles"].value == len(st.files)
+
+
+def test_orc_stripe_pruning_skips_stripes(tmp_path):
+    """Pushed predicates skip whole ORC stripes (reference:
+    GpuOrcScan stripe planning + OrcFilters SARG)."""
+    from spark_rapids_tpu.io.scans import FileScanExec
+    from spark_rapids_tpu.plan.physical import (ExecContext,
+                                                collect_batches)
+
+    cpu = srt.Session(tpu_enabled=False)
+    sess = srt.Session()
+    out = os.path.join(str(tmp_path), "orc")
+    big = {"a": np.arange(120_000), "b": np.arange(120_000) * 0.5}
+    cpu.create_dataframe(big, n_partitions=1).write_orc(
+        out, stripe_size=1 << 19)
+    df = sess.read_orc(out)
+    q = df.filter(df["a"] < 500)
+    phys = sess.physical_plan(q.plan)
+
+    def find(p):
+        if isinstance(p, FileScanExec):
+            return p
+        for c in p.children:
+            r = find(c)
+            if r is not None:
+                return r
+
+    scan = find(phys)
+    ctx = ExecContext(sess.conf, sess)
+    hb = collect_batches(phys.execute(ctx), phys.schema, ctx)
+    assert hb.num_rows == 500
+    assert scan.metrics_skipped_stripes > 0
+
+
+def test_csv_unsupported_options_rejected(tmp_path):
+    path = os.path.join(str(tmp_path), "t.csv")
+    with open(path, "w") as fh:
+        fh.write("a,b\n1,2\n")
+    sess = srt.Session()
+    with pytest.raises(ValueError, match="sep must be a single"):
+        sess.read_csv(path, sep=";;").collect()
+    with pytest.raises(ValueError, match="unsupported CSV options"):
+        sess.read_csv(path, quoteChar="'").collect()
+
+
 def test_write_then_query_pipeline(tmp_path, mixed_df_data):
     """Write -> scan -> filter+agg end-to-end on the device engine vs
     the oracle over the same files."""
