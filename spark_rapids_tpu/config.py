@@ -196,6 +196,31 @@ ALLOW_FLOAT_AGG = conf("spark.rapids.tpu.sql.variableFloatAgg.enabled").doc(
     "because the device order is deterministic for a fixed plan)"
 ).boolean_conf(True)
 
+# --- string cast gates (reference: RapidsConf.scala:373-403) --------------
+CAST_STRING_TO_INTEGER = conf(
+    "spark.rapids.tpu.sql.castStringToInteger.enabled").doc(
+    "Cast string->integral on device.  Exact for [+-]?digits[.digits] "
+    "(fractions truncate); exponent forms ('1e2') become NULL on device "
+    "where the host parses them.  Off by default like the reference "
+    "(RapidsConf.scala:397) — enable to keep string-cast pipelines on "
+    "device").boolean_conf(False)
+CAST_STRING_TO_FLOAT = conf(
+    "spark.rapids.tpu.sql.castStringToFloat.enabled").doc(
+    "Cast string->float on device.  Horner digit accumulation can be a "
+    "few ULPs off the host's correctly-rounded parse on long mantissas "
+    "(reference: castStringToFloat, same default)").boolean_conf(False)
+CAST_STRING_TO_TIMESTAMP = conf(
+    "spark.rapids.tpu.sql.castStringToTimestamp.enabled").doc(
+    "Cast string->date/timestamp on device: ISO 'YYYY[-MM[-DD]]"
+    "[ T]HH[:MM[:SS[.ffffff]]]' in UTC, malformed -> NULL.  Exotic "
+    "host-accepted forms (timezone suffixes, >6 fraction digits, "
+    "compact dates) become NULL on device.  Off by default like the "
+    "reference (RapidsConf.scala:373-403)").boolean_conf(False)
+# (no castFloatToString key: float->string stays host-side by design —
+# Spark's shortest-repr formatting has no faithful device analogue, see
+# ops/cast.py; the reference gates the same divergence behind its
+# castFloatToString conf)
+
 # --- test hooks (:456-463) ------------------------------------------------
 TEST_ENABLED = conf("spark.rapids.tpu.sql.test.enabled").doc(
     "Test mode: fail if any operator unexpectedly stays on the host engine "

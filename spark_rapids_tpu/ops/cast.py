@@ -1,11 +1,13 @@
 """Cast expression — the full primitive cast matrix.
 
 Capability parity with the reference's GpuCast.scala (all primitive casts
-including string<->numeric/timestamp).  Where the reference gates its
-divergence-prone GPU string-cast directions behind confs
-(castStringToFloat/castFloatToString/..., RapidsConf.scala:373-403), this
-engine routes every string-involved cast to the host oracle instead
-(``tpu_supported`` below) — same results, no divergence, no gate needed.
+including string<->numeric/timestamp).  String directions run ON DEVICE
+(ops/kernels/castkernels.py) with the reference's conf-gating scheme
+(RapidsConf.scala:373-403): string->integral and string->date/timestamp
+are exact and default on; string->float is ULP-divergent and defaults
+off (castStringToFloat); float->string stays host-side — Spark's
+shortest-repr formatting has no faithful device analogue, the same
+divergence the reference hides behind castFloatToString.
 
 Spark (non-ANSI) semantics implemented here:
   * int -> narrower int: bit truncation (Java narrowing)
@@ -16,8 +18,6 @@ Spark (non-ANSI) semantics implemented here:
   * string -> numeric/date/timestamp: trimmed; invalid input -> NULL
   * anything -> string: Spark's formatting (floats approximated, gated)
 
-Device path covers all non-string directions; string-involved casts run on
-the host engine via fallback tagging except string->string identity.
 """
 from __future__ import annotations
 
@@ -86,8 +86,6 @@ class Cast(Expression):
 
     # ------------------------------------------------------------------
     def eval_tpu(self, batch):
-        import jax.numpy as jnp
-
         c = self.child.eval_tpu(batch)
         if isinstance(c, Scalar):
             host = as_host_column(c, 1)
@@ -96,14 +94,28 @@ class Cast(Expression):
         src, dst = c.dtype, self.to
         if src == dst:
             return c
+        if src.is_string:
+            return _device_cast_from_string(c, dst)
+        if dst.is_string:
+            return _device_cast_to_string(c, dst)
         data, extra_null = _device_cast(c.data, src, dst)
         validity = c.validity if extra_null is None else c.validity & ~extra_null
         return DeviceColumn(dst, data, validity)
 
     @property
     def tpu_supported(self):
-        # string-involved casts stay on the host engine (round 1)
-        return not (self.child.dtype.is_string or self.to.is_string)
+        """String casts run on device (reference: GpuCast.scala:30-77)
+        except float->string, whose shortest-repr formatting has no
+        faithful device analogue; the divergent directions are further
+        gated by confs in the Cast rule's tag."""
+        src, dst = self.child.dtype, self.to
+        if not (src.is_string or dst.is_string):
+            return True
+        if src.is_string:
+            return dst.is_string or dst.id in _STRING_PARSE_TARGETS \
+                or dst.is_floating
+        # X -> string
+        return not src.is_floating
 
 
 def _float_int_bounds(dst: T.DType):
@@ -235,8 +247,13 @@ def _host_cast_from_string(data, valid, dst: T.DType):
             if not valid[i]:
                 continue
             try:
-                out[i] = np.datetime64(str(data[i]).strip(), "D").astype(
-                    np.int32)
+                d = np.datetime64(str(data[i]).strip(), "D")
+                # '' parses to NaT, whose int32 truncation is 0 — a
+                # silent 1970-01-01 instead of the null Spark produces
+                if np.isnat(d):
+                    extra_null[i] = True
+                else:
+                    out[i] = d.astype(np.int32)
             except ValueError:
                 extra_null[i] = True
         return out, extra_null
@@ -247,7 +264,11 @@ def _host_cast_from_string(data, valid, dst: T.DType):
                 continue
             s = str(data[i]).strip().replace(" ", "T")
             try:
-                out[i] = np.datetime64(s, "us").astype(np.int64)
+                ts = np.datetime64(s, "us")
+                if np.isnat(ts):
+                    extra_null[i] = True
+                else:
+                    out[i] = ts.astype(np.int64)
             except ValueError:
                 extra_null[i] = True
         return out, extra_null
@@ -262,11 +283,21 @@ def _host_cast_from_string(data, valid, dst: T.DType):
             extra_null[i] = True
         elif dst.is_integral:
             # Spark (non-ANSI) accepts decimal strings, truncating
-            # toward zero: '3.7' -> 3, '1e2' -> 100
+            # toward zero: '3.7' -> 3, '1e2' -> 100.  Plain decimal
+            # forms truncate EXACTLY on the integer digits (routing
+            # '704802607033127777.5' through float64 would round the
+            # integer part); only exponent forms take the float path.
             if s.lstrip("+-").isdigit():
                 iv = int(s)
             else:
-                iv = int(f) if abs(f) < 2 ** 63 else None
+                head, sep, tail = s.partition(".")
+                body = head.lstrip("+-")
+                if sep and (body.isdigit() or body == "") \
+                        and (tail == "" or tail.isdigit()) \
+                        and (body or tail):
+                    iv = int(head) if body else 0
+                else:
+                    iv = int(f) if abs(f) < 2 ** 63 else None
             lo, hi = _INT_RANGE[did]
             if iv is not None and lo <= iv <= hi:
                 out[i] = iv
@@ -275,6 +306,61 @@ def _host_cast_from_string(data, valid, dst: T.DType):
         else:
             out[i] = f
     return out, extra_null
+
+
+#: string-source targets with exact (or gated) device parses
+_STRING_PARSE_TARGETS = {
+    T.TypeId.BOOL, T.TypeId.INT8, T.TypeId.INT16, T.TypeId.INT32,
+    T.TypeId.INT64, T.TypeId.DATE32, T.TypeId.TIMESTAMP,
+}
+
+
+def _device_cast_from_string(c: DeviceColumn, dst: T.DType):
+    """Device parse of a string column (reference: GpuCast.scala
+    castStringTo* kernels).  Invalid input -> NULL, matching the host
+    oracle's semantics for every accepted format."""
+    import jax.numpy as jnp
+
+    from .kernels import castkernels as K
+
+    did = dst.id
+    if did is T.TypeId.BOOL:
+        data, ok = K.parse_bool(c.data, c.lengths, c.validity)
+        return DeviceColumn(dst, data, ok)
+    if did is T.TypeId.DATE32:
+        data, ok = K.parse_date(c.data, c.lengths, c.validity)
+        return DeviceColumn(dst, data, ok)
+    if did is T.TypeId.TIMESTAMP:
+        data, ok = K.parse_timestamp(c.data, c.lengths, c.validity)
+        return DeviceColumn(dst, data, ok)
+    if dst.is_floating:
+        data, ok = K.parse_float(c.data, c.lengths, c.validity)
+        return DeviceColumn(dst, data.astype(dst.jnp_dtype), ok)
+    # integral: range-check narrower targets like the host
+    data, ok = K.parse_int(c.data, c.lengths, c.validity)
+    if did is not T.TypeId.INT64:
+        lo, hi = _INT_RANGE[did]
+        ok = ok & (data >= lo) & (data <= hi)
+        data = data.astype(dst.jnp_dtype)
+    return DeviceColumn(dst, data, ok)
+
+
+def _device_cast_to_string(c: DeviceColumn, dst: T.DType):
+    """Device format of a primitive column to a string column —
+    byte-exact with the host for bool/int/date/timestamp (float stays
+    host-side, see Cast.tpu_supported)."""
+    from .kernels import castkernels as K
+
+    sid = c.dtype.id
+    if sid is T.TypeId.BOOL:
+        bm, lengths = K.format_bool(c.data, c.validity)
+    elif sid is T.TypeId.DATE32:
+        bm, lengths = K.format_date(c.data, c.validity)
+    elif sid is T.TypeId.TIMESTAMP:
+        bm, lengths = K.format_timestamp(c.data, c.validity)
+    else:
+        bm, lengths = K.format_int(c.data, c.validity)
+    return DeviceColumn(dst, bm, c.validity, lengths)
 
 
 def _device_cast(data, src: T.DType, dst: T.DType):

@@ -193,6 +193,14 @@ class TimeAdd(Expression):
                             c.validity)
 
 
+class TimeSub(TimeAdd):
+    """timestamp - literal interval microseconds (reference: the
+    TimeSub rule beside TimeAdd, GpuOverrides.scala:454-1449)."""
+
+    def __init__(self, child: Expression, interval_us: int):
+        super().__init__(child, -int(interval_us))
+
+
 class ToUnixTimestamp(UnaryExpression):
     """Seconds since epoch from a timestamp/date input (string-format
     parsing runs on the host engine via UnixTimestampParse)."""

@@ -1,0 +1,457 @@
+"""Device string<->primitive cast kernels.
+
+Reference analogue: GpuCast.scala:30-77 — the string cast directions
+run on the device, with the divergence-prone ones gated by confs
+(RapidsConf.scala:373-403).  Strings here are byte matrices
+(uint8 [n, w]) + lengths; every kernel is vectorized over rows with a
+static python loop over the (static) byte width, so one XLA program
+handles the whole column.
+
+Exactness contract (vs the host oracle's python parse/format):
+  * string->integral: EXACT for [+-]?digits[.digits] (the integer part
+    accumulates in int64 with precise overflow detection; fractions
+    truncate).  Exponent forms ('1e2') yield NULL on device where the
+    host parses them — the documented castStringToInteger divergence.
+  * string->bool, string->date, string->timestamp: exact for every
+    format the host accepts (ISO forms); malformed input -> NULL.
+  * int/bool/date/timestamp->string: byte-exact with the host.
+  * string->float: Horner-accumulated float64 — correct to a few ULPs
+    but NOT always the correctly-rounded strtod result; gated OFF by
+    default (castStringToFloat, like the reference).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+_INT64_MIN = -(2 ** 63)
+
+
+def _is_space(ch):
+    return (ch == 32) | ((ch >= 9) & (ch <= 13))
+
+
+def trim_aligned(bm, lengths):
+    """Left-align the trimmed token: returns (bytes [n, w], length)
+    with leading/trailing whitespace removed (host casts .strip())."""
+    import jax.numpy as jnp
+
+    n, w = bm.shape
+    in_len = jnp.arange(w, dtype=jnp.int32)[None, :] < lengths[:, None]
+    space = _is_space(bm) & in_len
+    # leading spaces: running AND from the left
+    lead = jnp.cumprod(jnp.where(in_len, space, True),
+                       axis=1, dtype=jnp.bool_)
+    n_lead = (lead & in_len).sum(axis=1).astype(jnp.int32)
+    # trailing spaces: running AND from the right over in-length bytes
+    rev = jnp.flip(space | ~in_len, axis=1)
+    trail = jnp.cumprod(rev, axis=1, dtype=jnp.bool_)
+    n_trail_plus_pad = trail.sum(axis=1).astype(jnp.int32)
+    pad = w - lengths.astype(jnp.int32)
+    n_trail = jnp.maximum(n_trail_plus_pad - pad, 0)
+    new_len = jnp.maximum(lengths.astype(jnp.int32) - n_lead - n_trail, 0)
+    idx = jnp.clip(n_lead[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :],
+                   0, w - 1)
+    aligned = jnp.take_along_axis(bm, idx, axis=1)
+    mask = jnp.arange(w, dtype=jnp.int32)[None, :] < new_len[:, None]
+    return jnp.where(mask, aligned, 0), new_len
+
+
+def parse_int(bm, lengths, validity) -> Tuple:
+    """[+-]?digits[.digits] -> (int64, valid).  The integer part is
+    exact (negative-space accumulation covers INT64_MIN); fraction
+    digits only validate.  Anything else -> invalid."""
+    import jax.numpy as jnp
+
+    b, L = trim_aligned(bm, lengths)
+    n, w = b.shape
+    c0 = b[:, 0]
+    neg = c0 == ord("-")
+    signed = neg | (c0 == ord("+"))
+    start = signed.astype(jnp.int32)
+    val = jnp.zeros(n, dtype=jnp.int64)
+    ovf = jnp.zeros(n, dtype=jnp.bool_)
+    seen_digit = jnp.zeros(n, dtype=jnp.bool_)
+    seen_dot = jnp.zeros(n, dtype=jnp.bool_)
+    bad = jnp.zeros(n, dtype=jnp.bool_)
+    for j in range(w):
+        ch = b[:, j]
+        active = (j < L) & (j >= start)
+        is_digit = (ch >= 48) & (ch <= 57)
+        is_dot = ch == 46
+        d = (ch - 48).astype(jnp.int64)
+        acc = active & is_digit & ~seen_dot
+        # negative-space accumulation: val' = val*10 - d must stay
+        # >= INT64_MIN, i.e. val >= (INT64_MIN + d + 9) // 10 exactly
+        lim = (jnp.int64(_INT64_MIN) + d + 9) // 10
+        will_ovf = val < lim
+        ovf = ovf | (acc & will_ovf)
+        val = jnp.where(acc & ~ovf, val * 10 - d, val)
+        seen_digit = seen_digit | (active & is_digit)
+        bad = bad | (active & ~(is_digit | (is_dot & ~seen_dot)))
+        seen_dot = seen_dot | (active & is_dot)
+    # positive magnitude: -INT64_MIN overflows
+    ovf = ovf | (~neg & (val == _INT64_MIN))
+    out = jnp.where(neg, val, -val)
+    ok = validity & seen_digit & ~bad & ~ovf
+    return out, ok
+
+
+def parse_bool(bm, lengths, validity) -> Tuple:
+    """t/true/y/yes/1 -> True, f/false/n/no/0 -> False (case-fold),
+    everything else invalid — the host oracle's accepted set."""
+    import jax.numpy as jnp
+
+    b, L = trim_aligned(bm, lengths)
+    n, w = b.shape
+    is_up = (b >= 65) & (b <= 90)
+    low = jnp.where(is_up, b + 32, b)
+
+    def eq(lit: str):
+        if len(lit) > w:
+            return jnp.zeros(n, dtype=jnp.bool_)
+        m = L == len(lit)
+        for j, chl in enumerate(lit):
+            m = m & (low[:, j] == ord(chl))
+        return m
+
+    true_m = eq("t") | eq("true") | eq("y") | eq("yes") | eq("1")
+    false_m = eq("f") | eq("false") | eq("n") | eq("no") | eq("0")
+    return true_m, validity & (true_m | false_m)
+
+
+def parse_float(bm, lengths, validity) -> Tuple:
+    """[+-]?digits[.digits][(e|E)[+-]digits] | inf | infinity | nan ->
+    (float64, valid).  Horner accumulation: a few ULPs from strtod on
+    long mantissas — why the castStringToFloat conf defaults off."""
+    import jax.numpy as jnp
+
+    b, L = trim_aligned(bm, lengths)
+    n, w = b.shape
+    is_up = (b >= 65) & (b <= 90)
+    low = jnp.where(is_up, b + 32, b)
+
+    c0 = low[:, 0]
+    neg = c0 == ord("-")
+    signed = neg | (c0 == ord("+"))
+    start = signed.astype(jnp.int32)
+
+    def lit_eq(lit: str):
+        # token after the sign equals the literal
+        m = (L - start) == len(lit)
+        for j, chl in enumerate(lit):
+            ch = _char_at(low, start + j)
+            m = m & (ch == ord(chl))
+        return m
+
+    inf_m = lit_eq("inf") | lit_eq("infinity")
+    nan_m = lit_eq("nan")
+
+    mant = jnp.zeros(n, dtype=jnp.float64)
+    frac_digits = jnp.zeros(n, dtype=jnp.int32)
+    exp_val = jnp.zeros(n, dtype=jnp.int32)
+    exp_neg = jnp.zeros(n, dtype=jnp.bool_)
+    seen_digit = jnp.zeros(n, dtype=jnp.bool_)
+    seen_dot = jnp.zeros(n, dtype=jnp.bool_)
+    seen_exp = jnp.zeros(n, dtype=jnp.bool_)
+    exp_seen_digit = jnp.zeros(n, dtype=jnp.bool_)
+    bad = jnp.zeros(n, dtype=jnp.bool_)
+    for j in range(w):
+        ch = low[:, j]
+        active = (j < L) & (j >= start)
+        is_digit = (ch >= 48) & (ch <= 57)
+        is_dot = ch == 46
+        is_e = ch == ord("e")
+        is_sign = (ch == ord("+")) | (ch == ord("-"))
+        # a sign is only legal immediately after the 'e'
+        prev_was_e = (low[:, j - 1] == ord("e")) if j > 0 else \
+            jnp.zeros(n, dtype=jnp.bool_)
+        d = (ch - 48).astype(jnp.float64)
+        m_acc = active & is_digit & ~seen_exp
+        mant = jnp.where(m_acc, mant * 10.0 + d, mant)
+        frac_digits = frac_digits + (m_acc & seen_dot)
+        seen_digit = seen_digit | m_acc
+        e_acc = active & is_digit & seen_exp
+        exp_val = jnp.where(
+            e_acc, jnp.minimum(exp_val * 10 + d.astype(jnp.int32),
+                               9999), exp_val)
+        exp_seen_digit = exp_seen_digit | e_acc
+        ok_dot = is_dot & ~seen_dot & ~seen_exp
+        ok_e = is_e & seen_digit & ~seen_exp
+        ok_sign = is_sign & seen_exp & prev_was_e & ~exp_seen_digit
+        bad = bad | (active & ~(is_digit | ok_dot | ok_e | ok_sign))
+        exp_neg = exp_neg | (active & (ch == ord("-")) & ok_sign)
+        seen_dot = seen_dot | (active & ok_dot)
+        seen_exp = seen_exp | (active & ok_e)
+    bad = bad | (seen_exp & ~exp_seen_digit) | ~seen_digit
+    e = jnp.where(exp_neg, -exp_val, exp_val) - frac_digits
+    value = mant * jnp.power(10.0, e.astype(jnp.float64))
+    value = jnp.where(inf_m, jnp.inf, value)
+    value = jnp.where(nan_m, jnp.nan, value)
+    value = jnp.where(neg, -value, value)
+    ok = validity & (inf_m | nan_m | ~bad)
+    return value, ok
+
+
+# --------------------------------------------------------------------------
+# civil-date arithmetic (Howard Hinnant's algorithms, public domain)
+# --------------------------------------------------------------------------
+def _days_from_civil(y, m, d):
+    import jax.numpy as jnp
+
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _civil_from_days(z):
+    import jax.numpy as jnp
+
+    z = z + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _read_digits(b, L, start, count):
+    """Fixed-position digit run: (value int32, all_digits bool).
+    ``start`` may be scalar or per-row."""
+    import jax.numpy as jnp
+
+    n, w = b.shape
+    val = jnp.zeros(n, dtype=jnp.int32)
+    ok = jnp.ones(n, dtype=jnp.bool_)
+    for k in range(count):
+        ch = _char_at(b, start + k)
+        is_digit = (ch >= 48) & (ch <= 57)
+        ok = ok & is_digit
+        val = val * 10 + jnp.where(is_digit, ch - 48, 0).astype(jnp.int32)
+    return val, ok
+
+
+def _char_at(b, pos):
+    """Byte column at ``pos`` — a python int (static, possibly past the
+    matrix edge -> zeros) or a per-row array (gathered, clipped)."""
+    import jax.numpy as jnp
+
+    n, w = b.shape
+    if isinstance(pos, (int, np.integer)):
+        return b[:, pos] if 0 <= pos < w else jnp.zeros(n, dtype=b.dtype)
+    col = jnp.clip(pos, 0, w - 1)
+    return jnp.take_along_axis(b, col[:, None], axis=1)[:, 0]
+
+
+def _parse_ymd(b, L):
+    """ISO date prefix: YYYY[-MM[-DD]] (the np.datetime64 forms the
+    host accepts).  Returns (days32, date_len, ok)."""
+    import jax.numpy as jnp
+
+    yv, y_ok = _read_digits(b, L, 0, 4)
+    full = L >= 10
+    ym = (L == 7) | (L >= 10)
+    mv4, m_ok = _read_digits(b, L, 5, 2)
+    dv4, d_ok = _read_digits(b, L, 8, 2)
+    sep1 = _char_at(b, 4) == ord("-")
+    sep2 = _char_at(b, 7) == ord("-")
+    m = jnp.where(ym, mv4, 1)
+    d = jnp.where(full, dv4, 1)
+    ok = y_ok & ((L == 4)
+                 | ((L == 7) & sep1 & m_ok)
+                 | (full & sep1 & sep2 & m_ok & d_ok))
+    # calendar validation (np.datetime64 rejects 2021-02-30)
+    leap = ((yv % 4 == 0) & (yv % 100 != 0)) | (yv % 400 == 0)
+    dim = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                      dtype=jnp.int32)
+    md = dim[jnp.clip(m - 1, 0, 11)] + ((m == 2) & leap)
+    ok = ok & (m >= 1) & (m <= 12) & (d >= 1) & (d <= md)
+    days = _days_from_civil(yv.astype(jnp.int64), m.astype(jnp.int64),
+                            d.astype(jnp.int64))
+    dlen = jnp.where(L >= 10, 10, jnp.where(L == 7, 7, 4))
+    return days, dlen, ok
+
+
+def parse_date(bm, lengths, validity):
+    """ISO 'YYYY[-MM[-DD]]' -> (int32 days, valid)."""
+    import jax.numpy as jnp
+
+    b, L = trim_aligned(bm, lengths)
+    days, dlen, ok = _parse_ymd(b, L)
+    ok = ok & ((L == 4) | (L == 7) | (L == 10))
+    return days.astype(jnp.int32), validity & ok
+
+
+def parse_timestamp(bm, lengths, validity):
+    """ISO 'date[ T]HH[:MM[:SS[.f{1,6}]]]' (UTC) -> (int64 micros,
+    valid) — the formats the host's np.datetime64(..., 'us') accepts."""
+    import jax.numpy as jnp
+
+    b, L = trim_aligned(bm, lengths)
+    days, _dlen, date_ok = _parse_ymd(b, L)
+    date_only = (L == 4) | (L == 7) | (L == 10)
+
+    has_time = L >= 13
+    sep = _char_at(b, 10)
+    sep_ok = (sep == ord(" ")) | (sep == ord("T"))
+    hv, h_ok = _read_digits(b, L, 11, 2)
+    # minutes / seconds optional
+    has_min = L >= 16
+    c13 = _char_at(b, 13) == ord(":")
+    mv, m_ok = _read_digits(b, L, 14, 2)
+    has_sec = L >= 19
+    c16 = _char_at(b, 16) == ord(":")
+    sv, s_ok = _read_digits(b, L, 17, 2)
+    # fraction: '.', 1-6 digits
+    has_frac = L >= 21
+    c19 = _char_at(b, 19) == ord(".")
+    fdig = jnp.clip(L - 20, 0, 6)
+    micros_f = jnp.zeros(b.shape[0], dtype=jnp.int32)
+    f_ok = jnp.ones(b.shape[0], dtype=jnp.bool_)
+    # the *10 shift on every iteration right-pads the fraction to
+    # exactly 6 digits (unused trailing slots contribute zeros)
+    for k in range(6):
+        ch = _char_at(b, 20 + k)
+        used = has_frac & (k < fdig)
+        is_digit = (ch >= 48) & (ch <= 57)
+        f_ok = f_ok & (~used | is_digit)
+        micros_f = micros_f * 10 + jnp.where(used & is_digit,
+                                             ch - 48, 0).astype(jnp.int32)
+
+    len_ok = date_only | (
+        sep_ok & ((L == 13)
+                  | ((L == 16) & c13)
+                  | ((L == 19) & c13 & c16)
+                  | (has_frac & (L <= 26) & c13 & c16 & c19)))
+    time_ok = ~has_time | (
+        h_ok & (hv < 24)
+        & (~has_min | (m_ok & (mv < 60)))
+        & (~has_sec | (s_ok & (sv < 60)))
+        & (~has_frac | f_ok))
+    hv = jnp.where(has_time, hv, 0)
+    mv = jnp.where(has_min, mv, 0)
+    sv = jnp.where(has_sec, sv, 0)
+    micros_f = jnp.where(has_frac, micros_f, 0)
+    us = (days * 86_400_000_000
+          + hv.astype(jnp.int64) * 3_600_000_000
+          + mv.astype(jnp.int64) * 60_000_000
+          + sv.astype(jnp.int64) * 1_000_000
+          + micros_f.astype(jnp.int64))
+    return us, validity & date_ok & len_ok & time_ok
+
+
+# --------------------------------------------------------------------------
+# X -> string
+# --------------------------------------------------------------------------
+_P10_U64 = [10 ** k for k in range(20)]
+
+
+def format_int(values, validity):
+    """int64 -> left-aligned decimal bytes (byte-exact with str(int)).
+    Returns (bytes [n, 20], lengths)."""
+    import jax.numpy as jnp
+
+    n = values.shape[0]
+    v = values.astype(jnp.int64)
+    negm = v < 0
+    # magnitude in uint64 (covers INT64_MIN)
+    mag = jnp.where(negm, (-(v + 1)).astype(jnp.uint64) + 1,
+                    v.astype(jnp.uint64))
+    p10 = jnp.asarray(_P10_U64, dtype=jnp.uint64)
+    ndig = jnp.ones(n, dtype=jnp.int32)
+    for k in range(1, 20):
+        ndig = ndig + (mag >= p10[k])
+    sign_off = negm.astype(jnp.int32)
+    length = ndig + sign_off
+    w = 20
+    cols = []
+    for j in range(w):
+        p = ndig - 1 - (jnp.int32(j) - sign_off)
+        digit = (mag // p10[jnp.clip(p, 0, 19)]) % jnp.uint64(10)
+        ch = jnp.where(negm & (j == sign_off - 1), ord("-"),
+                       48 + digit.astype(jnp.int32))
+        ch = jnp.where((j < length) & ((p >= 0) | (negm & (j == 0))),
+                       ch, 0)
+        cols.append(ch.astype(jnp.uint8))
+    bm = jnp.stack(cols, axis=1)
+    return bm, jnp.where(validity, length, 0)
+
+
+def format_bool(values, validity):
+    import jax.numpy as jnp
+
+    n = values.shape[0]
+    t = np.frombuffer(b"true\x00", dtype=np.uint8)
+    f = np.frombuffer(b"false", dtype=np.uint8)
+    bm = jnp.where(values[:, None].astype(jnp.bool_),
+                   jnp.asarray(t)[None, :], jnp.asarray(f)[None, :])
+    lengths = jnp.where(values.astype(jnp.bool_), 4, 5)
+    return bm.astype(jnp.uint8), jnp.where(validity, lengths, 0)
+
+
+def _format_2d(v):
+    """Two zero-padded digit bytes for 0<=v<100: returns (hi, lo)."""
+    return 48 + v // 10, 48 + v % 10
+
+
+def format_date(values, validity):
+    """date32 -> 'YYYY-MM-DD' (years 0..9999 byte-exact with the
+    host's str(np.datetime64))."""
+    import jax.numpy as jnp
+
+    y, m, d = _civil_from_days(values.astype(jnp.int64))
+    y = jnp.clip(y, 0, 9999).astype(jnp.int32)
+    m = m.astype(jnp.int32)
+    d = d.astype(jnp.int32)
+    cols = [48 + (y // 1000) % 10, 48 + (y // 100) % 10,
+            48 + (y // 10) % 10, 48 + y % 10,
+            jnp.full_like(y, ord("-"))]
+    mh, ml = _format_2d(m)
+    dh, dl = _format_2d(d)
+    cols += [mh, ml, jnp.full_like(y, ord("-")), dh, dl]
+    bm = jnp.stack([c.astype(jnp.uint8) for c in cols], axis=1)
+    return bm, jnp.where(validity, 10, 0)
+
+
+def format_timestamp(values, validity):
+    """timestamp(us) -> 'YYYY-MM-DD HH:MM:SS.ffffff' (the host's
+    str(np.datetime64(us)) with 'T' -> ' ')."""
+    import jax.numpy as jnp
+
+    us = values.astype(jnp.int64)
+    days = jnp.floor_divide(us, 86_400_000_000)
+    rem = us - days * 86_400_000_000
+    y, m, d = _civil_from_days(days)
+    y = jnp.clip(y, 0, 9999).astype(jnp.int32)
+    m = m.astype(jnp.int32)
+    d = d.astype(jnp.int32)
+    h = (rem // 3_600_000_000).astype(jnp.int32)
+    mi = ((rem // 60_000_000) % 60).astype(jnp.int32)
+    s = ((rem // 1_000_000) % 60).astype(jnp.int32)
+    f = (rem % 1_000_000).astype(jnp.int32)
+    dash = jnp.full_like(y, ord("-"))
+    colon = jnp.full_like(y, ord(":"))
+    cols = [48 + (y // 1000) % 10, 48 + (y // 100) % 10,
+            48 + (y // 10) % 10, 48 + y % 10, dash]
+    mh, ml = _format_2d(m)
+    dh, dl = _format_2d(d)
+    cols += [mh, ml, dash, dh, dl, jnp.full_like(y, ord(" "))]
+    hh, hl = _format_2d(h)
+    nh, nl = _format_2d(mi)
+    sh, sl = _format_2d(s)
+    cols += [hh, hl, colon, nh, nl, colon, sh, sl,
+             jnp.full_like(y, ord("."))]
+    for k in (100000, 10000, 1000, 100, 10, 1):
+        cols.append(48 + (f // k) % 10)
+    bm = jnp.stack([c.astype(jnp.uint8) for c in cols], axis=1)
+    return bm, jnp.where(validity, 26, 0)

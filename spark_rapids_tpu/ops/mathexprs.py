@@ -61,6 +61,36 @@ Log10 = _double_unary("Log10", np.log10, "log10")
 Sqrt = _double_unary("Sqrt", np.sqrt, "sqrt")
 Cbrt = _double_unary("Cbrt", np.cbrt, "cbrt")
 Rint = _double_unary("Rint", np.rint, "rint")
+Acosh = _double_unary("Acosh", np.arccosh, "arccosh")
+Asinh = _double_unary("Asinh", np.arcsinh, "arcsinh")
+Atanh = _double_unary("Atanh", np.arctanh, "arctanh")
+
+
+class Cot(_DoubleUnary):
+    """cot(x) = 1/tan(x) (reference registers Cot beside the trig set)."""
+
+    np_fn = staticmethod(lambda d: 1.0 / np.tan(d))
+    jnp_name = "tan"
+
+    def do_tpu(self, data):
+        jnp = _jnp()
+        return 1.0 / jnp.tan(data.astype(jnp.float64))
+
+
+class Logarithm(BinaryExpression):
+    """log(base, x) — Spark's two-argument Logarithm."""
+
+    def result_dtype(self, lt, rt):
+        return T.FLOAT64
+
+    def do_cpu(self, base, x):
+        return np.log(x.astype(np.float64)) / np.log(
+            base.astype(np.float64))
+
+    def do_tpu(self, base, x):
+        jnp = _jnp()
+        return jnp.log(x.astype(jnp.float64)) / jnp.log(
+            base.astype(jnp.float64))
 
 
 class Signum(UnaryExpression):

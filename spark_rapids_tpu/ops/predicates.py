@@ -396,6 +396,75 @@ class AtLeastNNonNulls(Expression):
 # --------------------------------------------------------------------------
 # In / InSet (reference: GpuInSet.scala)
 # --------------------------------------------------------------------------
+class In(Expression):
+    """``value IN (expr1, expr2, ...)`` with non-literal list members
+    (reference: GpuOverrides registers both In and InSet,
+    GpuOverrides.scala:454-1449; the optimizer turns all-literal lists
+    into InSet, so this node carries the general expression form).
+
+    Spark null semantics: TRUE if any member matches; otherwise NULL if
+    the value or any member is null; else FALSE."""
+
+    def __init__(self, child: Expression, list_exprs: List[Expression]):
+        super().__init__([child] + list(list_exprs))
+
+    @property
+    def dtype(self):
+        return T.BOOL
+
+    def sql(self):
+        items = ", ".join(e.sql() for e in self.children[1:])
+        return f"({self.children[0].sql()} IN ({items}))"
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        c = as_host_column(self.children[0].eval_cpu(batch), n)
+        c_valid = c.is_valid()
+        acc = np.zeros(n, dtype=np.bool_)
+        saw_null = ~c_valid.copy()
+        for e in self.children[1:]:
+            v = as_host_column(e.eval_cpu(batch), n)
+            v_valid = v.is_valid()
+            both = c_valid & v_valid
+            if c.dtype.is_string:
+                eq = np.fromiter(
+                    (a == b for a, b in zip(c.data, v.data)),
+                    dtype=np.bool_, count=n)
+            else:
+                # compare in the promoted type (1 IN (1.5) is FALSE):
+                # casting the member to the value's dtype would
+                # silently truncate floats
+                common = np.promote_types(c.dtype.np_dtype,
+                                          v.dtype.np_dtype)
+                eq = c.data.astype(common) == \
+                    np.asarray(v.data).astype(common)
+            acc |= both & eq
+            saw_null |= ~v_valid
+        validity = acc | ~saw_null
+        return HostColumn(T.BOOL, acc,
+                          None if bool(validity.all()) else validity)
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+
+        n = batch.padded_rows
+        c = as_device_column(self.children[0].eval_tpu(batch), n)
+        acc = jnp.zeros((n,), dtype=jnp.bool_)
+        saw_null = ~c.validity
+        for e in self.children[1:]:
+            v = as_device_column(e.eval_tpu(batch), n)
+            both = c.validity & v.validity
+            if c.dtype.is_string:
+                eq = sk.equals(c.data, c.lengths, v.data, v.lengths)
+            else:
+                common = np.promote_types(c.dtype.np_dtype,
+                                          v.dtype.np_dtype)
+                eq = c.data.astype(common) == v.data.astype(common)
+            acc = acc | (both & eq)
+            saw_null = saw_null | ~v.validity
+        return DeviceColumn(T.BOOL, acc, acc | ~saw_null)
+
+
 class InSet(Expression):
     def __init__(self, child: Expression, values: List):
         super().__init__([child])

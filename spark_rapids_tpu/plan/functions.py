@@ -111,6 +111,9 @@ class Column:
     def isin(self, *values) -> "Column":
         vals = list(values[0]) if len(values) == 1 and isinstance(
             values[0], (list, tuple, set)) else list(values)
+        if any(isinstance(v, (Column, Expression)) for v in vals):
+            # non-literal members: the general In form
+            return Column(pred.In(self.expr, [_e(v) for v in vals]))
         return Column(pred.InSet(self.expr, vals))
 
     def eq_null_safe(self, other) -> "Column":
@@ -315,6 +318,15 @@ signum = _u(m.Signum)
 rint = _u(m.Rint)
 degrees = _u(m.ToDegrees)
 radians = _u(m.ToRadians)
+asinh = _u(m.Asinh)
+acosh = _u(m.Acosh)
+atanh = _u(m.Atanh)
+cot = _u(m.Cot)
+
+
+def log_base(base, x) -> Column:
+    """Two-argument logarithm (Spark's log(base, expr))."""
+    return Column(m.Logarithm(_e(base), _e(x)))
 
 
 def pow(l, r) -> Column:  # noqa: A001

@@ -344,13 +344,39 @@ def _register_expression_rules():
     for cls in (pr.EqualTo, pr.LessThan, pr.LessThanOrEqual,
                 pr.GreaterThan, pr.GreaterThanOrEqual, pr.EqualNullSafe,
                 pr.Not, pr.And, pr.Or, pr.IsNull, pr.IsNotNull, pr.IsNaN,
-                pr.AtLeastNNonNulls, pr.InSet):
+                pr.AtLeastNNonNulls, pr.In, pr.InSet):
         register_expr(cls)
     # conditional / null
     for cls in (cond.If, cond.CaseWhen, ne.Coalesce, ne.NaNvl):
         register_expr(cls)
-    # cast & float normalization
-    register_expr(cst.Cast)
+    # cast & float normalization — string directions conf-gated like
+    # the reference (GpuCast.scala:30-77, RapidsConf.scala:373-403)
+    from ..config import (CAST_STRING_TO_FLOAT, CAST_STRING_TO_INTEGER,
+                          CAST_STRING_TO_TIMESTAMP)
+
+    def tag_cast(meta):
+        e = meta.expr
+        try:
+            src, dst = e.child.dtype, e.to
+        except Exception:  # noqa: BLE001 - unresolved child
+            return
+        if not src.is_string:
+            return
+        if dst.is_integral and not meta.conf.get(CAST_STRING_TO_INTEGER):
+            meta.will_not_work_on_tpu(
+                "string->integral cast disabled by "
+                f"{CAST_STRING_TO_INTEGER.key}")
+        if dst.is_floating and not meta.conf.get(CAST_STRING_TO_FLOAT):
+            meta.will_not_work_on_tpu(
+                "string->float cast on device can differ by a few ULPs "
+                f"from the host parse; enable {CAST_STRING_TO_FLOAT.key}")
+        if dst.id in (T.TypeId.DATE32, T.TypeId.TIMESTAMP) \
+                and not meta.conf.get(CAST_STRING_TO_TIMESTAMP):
+            meta.will_not_work_on_tpu(
+                "string->date/timestamp cast disabled by "
+                f"{CAST_STRING_TO_TIMESTAMP.key}")
+
+    register_expr(cst.Cast, tag=tag_cast)
     register_expr(cst.NormalizeNaNAndZero)
     register_expr(cst.KnownFloatingPointNormalized)
     # math: Spark computes in double; bit-exact transcendentals differ on
@@ -359,9 +385,10 @@ def _register_expression_rules():
     for cls in (m.Sqrt, m.Cbrt, m.Floor, m.Ceil, m.Signum, m.Rint,
                 m.ToDegrees, m.ToRadians, m.Pow, m.Atan2):
         register_expr(cls)
-    for cls in (m.Acos, m.Asin, m.Atan, m.Cos, m.Sin, m.Tan, m.Cosh,
-                m.Sinh, m.Tanh, m.Exp, m.Expm1, m.Log, m.Log1p, m.Log2,
-                m.Log10):
+    for cls in (m.Acos, m.Asin, m.Atan, m.Acosh, m.Asinh, m.Atanh,
+                m.Cos, m.Sin, m.Tan, m.Cot, m.Cosh, m.Sinh, m.Tanh,
+                m.Exp, m.Expm1, m.Log, m.Log1p, m.Log2, m.Log10,
+                m.Logarithm):
         register_expr(cls)
     # bitwise
     for cls in (bw.BitwiseAnd, bw.BitwiseOr, bw.BitwiseXor, bw.BitwiseNot,
@@ -370,8 +397,8 @@ def _register_expression_rules():
     # datetime
     for cls in (dt.Year, dt.Month, dt.DayOfMonth, dt.Hour, dt.Minute,
                 dt.Second, dt.DateAdd, dt.DateSub, dt.DateDiff,
-                dt.TimeAdd, dt.ToUnixTimestamp, dt.UnixTimestampParse,
-                dt.FromUnixTime):
+                dt.TimeAdd, dt.TimeSub, dt.ToUnixTimestamp,
+                dt.UnixTimestampParse, dt.FromUnixTime):
         register_expr(cls)
     # strings
     register_expr(s.Upper, incompat="ASCII-only case mapping on device")

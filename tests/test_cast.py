@@ -1,0 +1,180 @@
+"""Device string-cast matrix vs the host oracle.
+
+Reference analogue: GpuCast.scala:30-77 + CastOpSuite / cast_test.py —
+string parses (malformed -> NULL), exact X->string formatting, the
+conf-gated divergent directions (RapidsConf.scala:373-403), and
+randomized round trips.
+"""
+import random
+
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import f
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+)
+
+INTS = ["0", "42", "-7", "+15", " 99 ", "3.7", "-3.7", ".5", "-", "",
+        "abc", "9223372036854775807", "-9223372036854775808",
+        "9223372036854775808", "-9223372036854775809", "00123", "1.999",
+        "127", "128", "-128", "-129", None, "  -42  ", "4 2", "++1",
+        "1.", "1.2.3", "12345678901234567890"]
+
+#: the divergence-gated device directions, enabled for kernel tests
+#: (reference keeps them off by default, RapidsConf.scala:373-403)
+DEVICE_CAST_CONF = {
+    "spark.rapids.tpu.sql.castStringToInteger.enabled": True,
+    "spark.rapids.tpu.sql.castStringToTimestamp.enabled": True,
+}
+
+
+@pytest.mark.parametrize("to", ["bigint", "int", "smallint", "tinyint"])
+def test_string_to_integral(to):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["s"].cast(to).alias("x"), df["i"]),
+        {"s": INTS, "i": list(range(len(INTS)))},
+        conf=DEVICE_CAST_CONF)
+
+
+def test_string_to_bool():
+    vals = ["t", "TRUE", "Yes", "y", "1", "f", "False", "no", "N", "0",
+            "x", "", " true ", None, "truthy"]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["s"].cast("boolean").alias("x"),
+                             df["i"]),
+        {"s": vals, "i": list(range(len(vals)))})
+
+
+def test_string_to_date():
+    vals = ["2021-01-15", "1970-01-01", "2100-12-31", "2021-02-29",
+            "2020-02-29", "2021-13-01", "2021-00-10", "2021-1-5",
+            "2021", "2021-06", "junk", " 2021-03-04 ", "", None,
+            "2021-04-31", "0001-01-01", "9999-12-31"]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["s"].cast("date").alias("x"), df["i"]),
+        {"s": vals, "i": list(range(len(vals)))},
+        conf=DEVICE_CAST_CONF)
+
+
+def test_string_to_timestamp():
+    vals = ["2021-01-15 10:30:00", "2021-01-15T10:30:00",
+            "2021-01-15 10:30:00.123456", "2021-01-15 10:30:00.5",
+            "2021-01-15 10:30", "2021-01-15 10", "2021-01-15",
+            "1969-12-31 23:59:59.999999", "2021-01-15 24:00:00",
+            "2021-01-15 10:61:00", "2021-01-15x10:30:00", "", None,
+            "2021", "2021-06", "2021-01-15 10:30:61"]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["s"].cast("timestamp").alias("x"),
+                             df["i"]),
+        {"s": vals, "i": list(range(len(vals)))},
+        conf=DEVICE_CAST_CONF)
+
+
+def test_int_bool_to_string():
+    iv = [0, 1, -1, 42, -999999, 2 ** 62, -(2 ** 63), 2 ** 63 - 1,
+          None, 123456789]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["v"].cast("string").alias("x"),
+                             df["i"]),
+        {"v": iv, "i": list(range(len(iv)))})
+    bv = [True, False, None, True]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["v"].cast("string").alias("x"),
+                             df["i"]),
+        {"v": bv, "i": list(range(len(bv)))})
+
+
+def test_date_timestamp_to_string():
+    schema = T.Schema([T.Field("v", T.DATE32), T.Field("i", T.INT64)])
+    dv = [0, 18642, -3650, None, 2932896]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["v"].cast("string").alias("x"),
+                             df["i"]),
+        {"v": dv, "i": list(range(len(dv)))}, schema=schema)
+    schema = T.Schema([T.Field("v", T.TIMESTAMP), T.Field("i", T.INT64)])
+    tv = [0, 1611700200123456, -1, -86400000001, None,
+          1234567890000000]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["v"].cast("string").alias("x"),
+                             df["i"]),
+        {"v": tv, "i": list(range(len(tv)))}, schema=schema)
+
+
+def test_string_to_float_gated():
+    """string->float runs on device only under the castStringToFloat
+    conf (ULP-divergence gate, like the reference)."""
+    vals = ["1.5", "-2.25", "1e3", "2.5E-2", "inf", "-Infinity", "NaN",
+            "3", ".5", "1e", "x", "", None, "+0.125"]
+    conf = {"spark.rapids.tpu.sql.castStringToFloat.enabled": True}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["s"].cast("double").alias("x"),
+                             df["i"]),
+        {"s": vals, "i": list(range(len(vals)))}, conf=conf)
+    # default off: the expression tags to the host engine
+    sess = srt.Session()
+    df = sess.create_dataframe({"s": ["1.5"]})
+    ex = df.select(df["s"].cast("double").alias("x")).explain()
+    assert "castStringToFloat" in ex
+
+
+def test_cast_pipeline_stays_on_device_strict():
+    """scan-shaped pipeline: cast(string)->filter->agg never leaves the
+    device under strict test mode (VERDICT r4 item 5's done bar)."""
+    strict = srt.Session({
+        "spark.rapids.tpu.sql.test.enabled": True,
+        "spark.rapids.tpu.sql.test.allowedNonTpu": "ShuffleExchangeExec",
+        **DEVICE_CAST_CONF,
+    })
+    df = strict.create_dataframe(
+        {"s": ["10", "20", "30", "bad", "40"], "g": [1, 1, 2, 2, 2]})
+    out = (df.select(df["s"].cast("bigint").alias("v"), df["g"])
+             .filter(f.col("v") > 15)
+             .group_by("g").agg(f.sum("v").alias("sv"))).collect()
+    assert sorted(out) == [(1, 20), (2, 70)]
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_fuzz_cast_round_trips(seed):
+    """Randomized cast round trips: int -> string -> int is the
+    identity; random digit-strings parse identically on both engines;
+    date -> string -> date round-trips."""
+    rng = random.Random(seed)
+    n = 300
+    ints = [None if rng.random() < 0.1 else
+            rng.randrange(-(2 ** 63), 2 ** 63) for _ in range(n)]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            df["v"].cast("string").cast("bigint").alias("x"), df["i"]),
+        {"v": ints, "i": list(range(n))}, conf=DEVICE_CAST_CONF)
+
+    def rand_numeric_string():
+        r = rng.random()
+        if r < 0.1:
+            return None
+        if r < 0.2:
+            return "".join(rng.choice("0123456789abc .-+")
+                           for _ in range(rng.randrange(0, 8)))
+        s = rng.choice(["", "-", "+"])
+        s += "".join(rng.choice("0123456789")
+                     for _ in range(rng.randrange(1, 21)))
+        if rng.random() < 0.3:
+            s += "." + "".join(rng.choice("0123456789")
+                               for _ in range(rng.randrange(0, 4)))
+        return s
+
+    strs = [rand_numeric_string() for _ in range(n)]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(df["s"].cast("bigint").alias("x"),
+                             df["i"]),
+        {"s": strs, "i": list(range(n))}, conf=DEVICE_CAST_CONF)
+
+    days = [None if rng.random() < 0.1 else rng.randrange(-30000, 80000)
+            for _ in range(n)]
+    schema = T.Schema([T.Field("v", T.DATE32), T.Field("i", T.INT64)])
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            df["v"].cast("string").cast("date").alias("x"), df["i"]),
+        {"v": days, "i": list(range(n))}, schema=schema,
+        conf=DEVICE_CAST_CONF)

@@ -101,6 +101,22 @@ def test_api_validation_clean():
     assert validate() == []
 
 
+def test_reference_expression_drift_empty():
+    """The registry must cover the reference's expr rule table with no
+    undocumented gaps (VERDICT r4 item 8); skips when the reference
+    tree is absent (end-user installs)."""
+    import pytest
+
+    from spark_rapids_tpu.testing.api_validation import (
+        reference_expression_drift,
+    )
+
+    drift = reference_expression_drift()
+    if drift is None:
+        pytest.skip("reference tree not available")
+    assert drift["missing"] == [], drift["missing"]
+
+
 def test_config_docs_up_to_date():
     """docs/configs.md must match the registry (regenerate with
     python -c 'from spark_rapids_tpu.plan.overrides import

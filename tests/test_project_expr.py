@@ -268,3 +268,42 @@ def test_string_replace_multibyte_falls_back(strict_tpu_session):
     df = strict_tpu_session.create_dataframe({"s": ["abab"]})
     with pytest.raises(AssertionError):
         df.select(f.replace(df["s"], "ab", "x").alias("m")).collect()
+
+
+def test_in_expression_non_literal():
+    """value IN (expr, ...) with column members (reference registers In
+    beside InSet) incl. Spark's NULL-member semantics."""
+    data = {"a": [1, 2, 3, None, 5],
+            "b": [1, 0, 3, 4, None],
+            "c": [9, 2, 0, 4, 5]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            df["a"].isin(df["b"], df["c"]).alias("m"), df["a"]), data)
+    strs = {"s": ["x", "y", None, "zz"], "t": ["x", "q", "w", "zz"],
+            "u": ["a", "y", None, "b"]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            df["s"].isin(df["t"], df["u"]).alias("m"), df["s"]), strs)
+
+
+def test_time_sub():
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.ops.datetimeexprs import TimeSub
+    from spark_rapids_tpu.plan.functions import Column
+
+    schema = T.Schema([T.Field("ts", T.TIMESTAMP)])
+    data = {"ts": [0, 1611700200123456, None, -5]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            Column(TimeSub(df["ts"].expr, 3_600_000_000)).alias("m")),
+        data, schema=schema)
+
+
+def test_new_math_exprs():
+    data = {"x": [0.5, 1.0, 2.0, -0.5, None, 10.0]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda df: df.select(
+            f.asinh(df["x"]).alias("as"), f.acosh(df["x"]).alias("ac"),
+            f.atanh(df["x"]).alias("at"), f.cot(df["x"]).alias("ct"),
+            f.log_base(2.0, df["x"]).alias("lb")),
+        data, approximate_float=1e-12)
