@@ -21,12 +21,16 @@ every controller, so every decision must derive from replicated values
 — the runner pmax-replicates capacity aux outputs for exactly this
 reason (see DistributedRunner._run_stage).
 
-Process-local leaf execution: non-distributable subtrees (scans, host
-fallbacks) are executed by EVERY process — deterministically identical
-— and each process materializes only its addressable shards
-(``jax.make_array_from_callback``).  This mirrors Spark recomputing a
-partition's lineage on whichever executor owns the task, without a
-driver shipping bytes.
+Per-process split ownership: each controller decodes ONLY the leaf
+partitions assigned to shards on its own devices (reference: every
+executor reads its own splits, GpuParquetScan.scala:174; per-map-task
+shuffle outputs, RapidsShuffleInternalManager.scala:90-138) and
+materializes them as its addressable shards
+(``jax.make_array_from_callback``).  Global shard shapes are agreed via
+one tiny host allgather of (row-count, string-width) maxima, so every
+process compiles the identical program without seeing peer bytes.
+Sources with fewer partitions than the mesh are small by construction
+and replicate deterministically through the base path instead.
 """
 from __future__ import annotations
 
@@ -102,6 +106,176 @@ class MultiProcessRunner(DistributedRunner):
       * the final collect gathers every process's shards
         (``multihost_utils.process_allgather`` — the read side of the
         reference's fetch protocol, RapidsShuffleIterator.scala:45)."""
+
+    def _owned_shards(self) -> List[int]:
+        import jax
+
+        pidx = jax.process_index()
+        return [s for s, d in enumerate(np.asarray(
+            self.mesh.devices).flat) if d.process_index == pidx]
+
+    # ---------------- per-process split ownership ---------------------
+    def _run_leaf(self, node, ctx) -> DeviceBatch:
+        """Decode ONLY this process's splits (see module docstring).
+        Split -> shard assignment is the same deterministic
+        ``pid % n_shards`` the base runner uses, restricted to the
+        shards on this process's devices."""
+        from ..exec.base import TpuExec
+        from ..plan.physical import _empty_batch
+
+        is_dev = isinstance(node, TpuExec)
+        data = node.execute_columnar(ctx) if is_dev else node.execute(ctx)
+        n_parts = data.n_partitions
+        if n_parts < self.n:
+            # small source: replicated identical execution on every
+            # controller (the pre-ownership behavior)
+            return super()._run_leaf(node, ctx, data=data)
+
+        owned = self._owned_shards()
+        ownset = set(owned)
+        my_pids = [p for p in range(n_parts) if p % self.n in ownset]
+
+        sem = None
+        if ctx is not None and getattr(ctx, "session", None) is not None \
+                and ctx.session.device_manager is not None:
+            sem = ctx.session.device_manager.semaphore
+
+        def drain(pid: int) -> List[HostBatch]:
+            try:
+                if is_dev:
+                    return [device_to_host(db)
+                            for db in data.iterator(pid)]
+                return list(data.iterator(pid))
+            finally:
+                if sem is not None:
+                    sem.release_all()
+
+        threads = 1
+        if ctx is not None and len(my_pids) > 1:
+            from ..config import TASK_THREADS
+
+            threads = min(ctx.conf.get(TASK_THREADS), len(my_pids))
+        if threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                per_pid = list(pool.map(drain, my_pids))
+        else:
+            per_pid = [drain(p) for p in my_pids]
+
+        shard_lists = {s: [] for s in owned}
+        for pid, bs in zip(my_pids, per_pid):
+            shard_lists[pid % self.n].extend(
+                b for b in bs if b.num_rows)
+        shards = {s: (HostBatch.concat(bs) if bs
+                      else _empty_batch(node.schema))
+                  for s, bs in shard_lists.items()}
+        return self._place_owned(shards, node.schema)
+
+    def _place_owned(self, shards, schema) -> DeviceBatch:
+        """Build the global stacked mesh arrays from OWNED shards only.
+        Shapes must be identical on every controller, so the bucket and
+        string widths come from an allgather of local maxima — the only
+        cross-process traffic the leaf costs."""
+        import jax
+        from jax.experimental import multihost_utils
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .. import types as T
+        from ..data import strings as dstrings
+        from ..data.column import DeviceColumn, bucket_rows
+
+        mesh = self.mesh
+        n = self.n
+        str_cols = [ci for ci, f in enumerate(schema)
+                    if f.dtype.id is T.TypeId.STRING]
+        # encode owned strings once; agree on (rows, width) maxima
+        encs = {}  # (shard, ci) -> (bm, ln)
+        local_rows = max((b.num_rows for b in shards.values()),
+                         default=0)
+        local_w = {ci: 1 for ci in str_cols}
+        for s, b in shards.items():
+            for ci in str_cols:
+                bm, ln = dstrings.encode(b.columns[ci].data,
+                                         b.columns[ci].validity)
+                encs[(s, ci)] = (bm, ln)
+                local_w[ci] = max(local_w[ci], bm.shape[1])
+        stats = np.asarray([local_rows]
+                           + [local_w[ci] for ci in str_cols],
+                           dtype=np.int64)
+        agreed = multihost_utils.process_allgather(stats).max(axis=0)
+        bucket = bucket_rows(max(int(agreed[0]), 1), self.min_bucket)
+        widths = {ci: int(w) for ci, w in zip(str_cols, agreed[1:])}
+
+        def garr(shape, dtype, fill):
+            """Global [n, ...] array whose addressable shards come from
+            ``fill`` (shard idx -> local array without the lead axis)."""
+            sh = NamedSharding(mesh, P(*([self.axis]
+                                         + [None] * (len(shape) - 1))))
+
+            def cb(idx):
+                s = idx[0].start or 0
+                return fill(s)[None, ...].astype(dtype, copy=False)
+
+            return jax.make_array_from_callback(shape, sh, cb)
+
+        cols = []
+        for ci, f in enumerate(schema):
+            if ci in widths:
+                w = widths[ci]
+
+                def fill_data(s, ci=ci, w=w):
+                    bm, _ln = encs[(s, ci)]
+                    out = np.zeros((bucket, w), dtype=np.uint8)
+                    out[:bm.shape[0], :bm.shape[1]] = bm
+                    return out
+
+                def fill_len(s, ci=ci):
+                    _bm, ln = encs[(s, ci)]
+                    out = np.zeros(bucket, dtype=np.int32)
+                    out[:ln.shape[0]] = ln
+                    return out
+
+                def fill_valid(s, ci=ci):
+                    b = shards[s]
+                    out = np.zeros(bucket, dtype=np.bool_)
+                    out[:b.num_rows] = b.columns[ci].is_valid()
+                    return out
+
+                cols.append(DeviceColumn(
+                    f.dtype,
+                    garr((n, bucket, w), np.uint8, fill_data),
+                    garr((n, bucket), np.bool_, fill_valid),
+                    garr((n, bucket), np.int32, fill_len)))
+            else:
+                def fill_data(s, ci=ci, dt=f.dtype.np_dtype):
+                    b = shards[s]
+                    c = b.columns[ci]
+                    out = np.zeros(bucket, dtype=dt)
+                    valid = c.is_valid()
+                    src = np.where(valid, c.data,
+                                   np.zeros_like(c.data)) \
+                        if c.validity is not None else c.data
+                    out[:b.num_rows] = src
+                    return out
+
+                def fill_valid(s, ci=ci):
+                    b = shards[s]
+                    out = np.zeros(bucket, dtype=np.bool_)
+                    out[:b.num_rows] = b.columns[ci].is_valid()
+                    return out
+
+                cols.append(DeviceColumn(
+                    f.dtype,
+                    garr((n, bucket), f.dtype.np_dtype, fill_data),
+                    garr((n, bucket), np.bool_, fill_valid)))
+
+        sh = NamedSharding(mesh, P(self.axis))
+        rows = jax.make_array_from_callback(
+            (n,), sh,
+            lambda idx: np.asarray(
+                [shards[idx[0].start or 0].num_rows], dtype=np.int32))
+        return DeviceBatch(schema, cols, rows)
 
     def _place(self, stacked: DeviceBatch) -> DeviceBatch:
         import jax

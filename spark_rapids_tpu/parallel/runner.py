@@ -158,19 +158,25 @@ class DistributedRunner:
         return stages, leaves
 
     # ---------------- leaf execution ----------------------------------
-    def _run_leaf(self, node, ctx) -> DeviceBatch:
+    def _run_leaf(self, node, ctx, data=None) -> DeviceBatch:
         """Execute a non-distributable subtree locally and place it on
         the mesh.  Partitions are drained CONCURRENTLY (task thread
         pool) and assigned round-robin to shards, so input decode
         parallelizes and no global host concat funnels every byte
         through one array (reference: each task reads its own split,
         GpuParquetScan.scala:174).  When the source has too few
-        partitions to cover the mesh, rows are re-split evenly."""
+        partitions to cover the mesh, rows are re-split evenly.
+        ``data``: already-executed partitions of ``node`` (the
+        multi-process runner probes the partition count before deciding
+        its ownership path — re-executing here would build the subtree
+        twice)."""
         from ..exec.base import TpuExec
         from ..plan.physical import _empty_batch
 
         is_dev = isinstance(node, TpuExec)
-        data = node.execute_columnar(ctx) if is_dev else node.execute(ctx)
+        if data is None:
+            data = node.execute_columnar(ctx) if is_dev \
+                else node.execute(ctx)
         n_parts = data.n_partitions
 
         sem = None

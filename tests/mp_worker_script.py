@@ -5,8 +5,15 @@ TPC-H-shaped join+agg plan, executes it through MultiProcessRunner over
 the global mesh, and checks the gathered result against the local host
 oracle.  Run by tests/test_multiprocess.py as:
 
-    python tests/mp_worker_script.py <coordinator> <nprocs> <pid>
+    python tests/mp_worker_script.py <coordinator> <nprocs> <pid> \
+        [scan_dir]
+
+With ``scan_dir`` (a pre-created multi-file parquet dataset) the worker
+also runs a distributed scan+agg, records which FILES this process
+opened, and prints them — the test asserts the per-process open sets
+are disjoint (per-process split ownership, GpuParquetScan.scala:174).
 """
+import os
 import sys
 
 
@@ -65,6 +72,39 @@ def main():
         # payload columns while ordering the key must fail here
         assert g[0] == w[0], (g, w)
         assert abs(g[1] - w[1]) < 1e-9, (g, w)
+
+    # --- per-process split ownership over a file scan -----------------
+    scan_dir = sys.argv[4] if len(sys.argv) > 4 else None
+    if scan_dir:
+        from spark_rapids_tpu.io import scans as S
+
+        opened = []
+        orig = S.FileScanExec._read_file
+
+        def spy(self, fi, _orig=orig, _opened=opened):
+            _opened.append(self.files[fi])
+            return _orig(self, fi)
+
+        S.FileScanExec._read_file = spy
+        try:
+            def qf(s):
+                df = s.read_parquet(scan_dir)
+                return df.group_by("g").agg(
+                    F.sum("v").alias("sv"), F.count("v").alias("c"))
+
+            got2 = sorted(
+                run_distributed_mp(sess, qf(sess), mesh).to_rows())
+        finally:
+            S.FileScanExec._read_file = orig
+        want2 = sorted(qf(cpu).collect())
+        assert len(got2) == len(want2), (len(got2), len(want2))
+        for g, w in zip(got2, want2):
+            assert g[0] == w[0] and g[2] == w[2], (g, w)
+            assert abs(g[1] - w[1]) < 1e-6 * max(1.0, abs(w[1])), (g, w)
+        names = sorted({os.path.basename(p) for p in opened})
+        print(f"MP OPENED pid={pid} files={','.join(names)}",
+              flush=True)
+
     print(f"MP RESULT OK pid={pid} rows={len(got)} "
           f"sorted={len(sorted_got)}", flush=True)
 

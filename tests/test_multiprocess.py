@@ -23,7 +23,20 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_shuffled_join_oracle_equal():
+def test_two_process_shuffled_join_oracle_equal(tmp_path):
+    # pre-create a multi-file parquet dataset (>= 8 files so every
+    # global shard owns at least one split) for the ownership check
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+
+    rng = np.random.RandomState(7)
+    scan_dir = os.path.join(str(tmp_path), "scan")
+    srt.Session(tpu_enabled=False).create_dataframe(
+        {"g": rng.randint(0, 5, 4000),
+         "v": (rng.rand(4000) * 100).round(6)},
+        n_partitions=8).write_parquet(scan_dir)
+
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     script = os.path.join(os.path.dirname(__file__),
@@ -35,7 +48,7 @@ def test_two_process_shuffled_join_oracle_equal():
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
 
     procs = [subprocess.Popen(
-        [sys.executable, script, coordinator, "2", str(pid)],
+        [sys.executable, script, coordinator, "2", str(pid), scan_dir],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env, cwd=repo) for pid in range(2)]
     outs = []
@@ -48,7 +61,20 @@ def test_two_process_shuffled_join_oracle_equal():
             p.kill()
         pytest.fail("multi-process workers timed out:\n"
                     + "\n".join(o or "" for o in outs))
+    opened = {}
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, \
             f"worker {pid} rc={p.returncode}:\n{out[-4000:]}"
         assert f"MP RESULT OK pid={pid}" in out, out[-4000:]
+        for line in out.splitlines():
+            if line.startswith(f"MP OPENED pid={pid} "):
+                opened[pid] = set(
+                    line.split("files=", 1)[1].split(","))
+    # per-process split ownership: disjoint file-open sets covering
+    # the dataset (reference: GpuParquetScan.scala:174)
+    assert set(opened) == {0, 1}, opened
+    assert opened[0] and opened[1]
+    assert not (opened[0] & opened[1]), opened
+    all_files = {f for f in os.listdir(scan_dir)
+                 if f.startswith("part-")}
+    assert opened[0] | opened[1] == all_files, (opened, all_files)
