@@ -21,9 +21,15 @@ from ._util import pick as _pick, schema_of as _schema
 CATEGORIES = ["Books", "Electronics", "Home", "Clothing", "Sports",
               "Music", "Toys", "Garden", "Jewelry", "Shoes"]
 CLASSES = ["premium", "economy", "standard", "deluxe", "basic"]
-STATES = ["CA", "NY", "TX", "WA", "IL", "FL", "GA", "OH", "MI", "NC"]
-EDUCATION = ["Primary", "Secondary", "College", "Advanced Degree",
-             "Unknown"]
+# includes every state set the reference queries predicate on
+# (Q9Like's KY/GA/NM, MT/OR/IN, WI/MO/WV bands)
+STATES = ["CA", "NY", "TX", "WA", "IL", "FL", "GA", "OH", "MI", "NC",
+          "KY", "NM", "MT", "OR", "IN", "WI", "MO", "WV"]
+# includes the education levels the reference predicates on
+# (Q5Like/Q9Like's '4 yr Degree' / '2 yr Degree')
+EDUCATION = ["Primary", "Secondary", "College", "4 yr Degree",
+             "2 yr Degree", "Advanced Degree", "Unknown"]
+COUNTRIES = ["United States", "Canada"]
 MARITAL = ["M", "S", "D", "W", "U"]
 GENDER = ["M", "F"]
 REVIEW_WORDS = ["great", "terrible", "excellent", "poor", "love",
@@ -80,7 +86,8 @@ def generate(sf: float = 0.001, seed: int = 99):
                     "i_category": np.array(CATEGORIES, dtype=object)[cat_id],
                     "i_category_id": cat_id.astype(np.int32),
                     "i_class": _pick(rng, n_item, CLASSES),
-                    "i_class_id": rng.integers(0, len(CLASSES), n_item)
+                    # 1..15 — the class-id space Q26Like pivots over
+                    "i_class_id": rng.integers(1, 16, n_item)
                     .astype(np.int32),
                     "i_current_price": np.round(
                         rng.uniform(0.5, 300.0, n_item), 2),
@@ -108,12 +115,17 @@ def generate(sf: float = 0.001, seed: int = 99):
                             1, n_cust + 1, n_cust).astype(np.int64)})
     out["customer_address"] = (_schema([("ca_address_sk", T.INT64),
                                         ("ca_state", T.STRING),
-                                        ("ca_city", T.STRING)]),
+                                        ("ca_city", T.STRING),
+                                        ("ca_country", T.STRING)]),
                                {"ca_address_sk": csk,
                                 "ca_state": _pick(rng, n_cust, STATES),
                                 "ca_city": np.array(
                                     [f"City{i % 53}" for i in csk],
-                                    dtype=object)})
+                                    dtype=object),
+                                "ca_country": np.where(
+                                    rng.random(n_cust) < 0.9,
+                                    COUNTRIES[0], COUNTRIES[1])
+                                .astype(object)})
     out["customer_demographics"] = (
         _schema([("cd_demo_sk", T.INT64),
                  ("cd_gender", T.STRING),
@@ -133,10 +145,12 @@ def generate(sf: float = 0.001, seed: int = 99):
                          [f"Store{i}" for i in ssk], dtype=object)})
     wsk = np.arange(1, n_wh + 1, dtype=np.int64)
     out["warehouse"] = (_schema([("w_warehouse_sk", T.INT64),
-                                 ("w_warehouse_name", T.STRING)]),
+                                 ("w_warehouse_name", T.STRING),
+                                 ("w_state", T.STRING)]),
                         {"w_warehouse_sk": wsk,
                          "w_warehouse_name": np.array(
-                             [f"Warehouse{i}" for i in wsk], dtype=object)})
+                             [f"Warehouse{i}" for i in wsk], dtype=object),
+                         "w_state": _pick(rng, n_wh, STATES)})
 
     # store_sales -----------------------------------------------------------
     ss_item = rng.integers(1, n_item + 1, n_ss).astype(np.int64)
@@ -146,17 +160,21 @@ def generate(sf: float = 0.001, seed: int = 99):
                                    ("ss_item_sk", T.INT64),
                                    ("ss_customer_sk", T.INT64),
                                    ("ss_cdemo_sk", T.INT64),
+                                   ("ss_addr_sk", T.INT64),
                                    ("ss_store_sk", T.INT64),
                                    ("ss_ticket_number", T.INT64),
                                    ("ss_quantity", T.INT32),
                                    ("ss_sales_price", T.FLOAT64),
-                                   ("ss_net_paid", T.FLOAT64)]),
+                                   ("ss_net_paid", T.FLOAT64),
+                                   ("ss_net_profit", T.FLOAT64)]),
                           {"ss_sold_date_sk": rng.integers(0, N_DAYS, n_ss)
                            .astype(np.int64),
                            "ss_item_sk": ss_item,
                            "ss_customer_sk": rng.integers(
                                1, n_cust + 1, n_ss).astype(np.int64),
                            "ss_cdemo_sk": rng.integers(
+                               1, n_cust + 1, n_ss).astype(np.int64),
+                           "ss_addr_sk": rng.integers(
                                1, n_cust + 1, n_ss).astype(np.int64),
                            "ss_store_sk": rng.integers(
                                1, n_store + 1, n_ss).astype(np.int64),
@@ -165,7 +183,11 @@ def generate(sf: float = 0.001, seed: int = 99):
                                1, max(2, n_ss // 4), n_ss)).astype(np.int64),
                            "ss_quantity": ss_qty,
                            "ss_sales_price": ss_price,
-                           "ss_net_paid": np.round(ss_price * ss_qty, 2)})
+                           "ss_net_paid": np.round(ss_price * ss_qty, 2),
+                           # spans Q9Like's profit bands (0-2000,
+                           # 150-3000, 50-25000) with negatives mixed in
+                           "ss_net_profit": np.round(
+                               rng.uniform(-500.0, 26_000.0, n_ss), 2)})
 
     # web_sales -------------------------------------------------------------
     ws_price = np.round(rng.uniform(1.0, 300.0, n_ws), 2)
@@ -174,6 +196,7 @@ def generate(sf: float = 0.001, seed: int = 99):
                                  ("ws_item_sk", T.INT64),
                                  ("ws_bill_customer_sk", T.INT64),
                                  ("ws_order_number", T.INT64),
+                                 ("ws_warehouse_sk", T.INT64),
                                  ("ws_quantity", T.INT32),
                                  ("ws_sales_price", T.FLOAT64),
                                  ("ws_net_paid", T.FLOAT64)]),
@@ -185,6 +208,8 @@ def generate(sf: float = 0.001, seed: int = 99):
                              1, n_cust + 1, n_ws).astype(np.int64),
                          "ws_order_number": np.sort(rng.integers(
                              1, max(2, n_ws // 3), n_ws)).astype(np.int64),
+                         "ws_warehouse_sk": rng.integers(
+                             1, n_wh + 1, n_ws).astype(np.int64),
                          "ws_quantity": ws_qty,
                          "ws_sales_price": ws_price,
                          "ws_net_paid": np.round(ws_price * ws_qty, 2)})
@@ -214,7 +239,8 @@ def generate(sf: float = 0.001, seed: int = 99):
                  ("wr_item_sk", T.INT64),
                  ("wr_refunded_customer_sk", T.INT64),
                  ("wr_order_number", T.INT64),
-                 ("wr_return_quantity", T.INT32)]),
+                 ("wr_return_quantity", T.INT32),
+                 ("wr_refunded_cash", T.FLOAT64)]),
         {"wr_returned_date_sk": (
             out["web_sales"][1]["ws_sold_date_sk"][wr_idx]
             + rng.integers(1, 90, n_wr)).astype(np.int64),
@@ -222,7 +248,10 @@ def generate(sf: float = 0.001, seed: int = 99):
          "wr_refunded_customer_sk":
              out["web_sales"][1]["ws_bill_customer_sk"][wr_idx],
          "wr_order_number": out["web_sales"][1]["ws_order_number"][wr_idx],
-         "wr_return_quantity": rng.integers(1, 5, n_wr).astype(np.int32)})
+         "wr_return_quantity": rng.integers(1, 5, n_wr).astype(np.int32),
+         "wr_refunded_cash": np.round(
+             out["web_sales"][1]["ws_sales_price"][wr_idx]
+             * rng.uniform(0.1, 1.0, n_wr), 2)})
 
     # web_clickstreams ------------------------------------------------------
     out["web_clickstreams"] = (

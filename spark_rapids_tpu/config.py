@@ -196,6 +196,16 @@ ALLOW_FLOAT_AGG = conf("spark.rapids.tpu.sql.variableFloatAgg.enabled").doc(
     "because the device order is deterministic for a fixed plan)"
 ).boolean_conf(True)
 
+STRING_COLUMN_BYTES_GUARD = conf(
+    "spark.rapids.tpu.sql.stringColumnBytesGuard").doc(
+    "Fail a device upload whose string byte-matrix would exceed this "
+    "many bytes per column.  Byte-matrix HBM is rows x max_len, so one "
+    "pathological long string in a wide batch silently multiplies the "
+    "footprint (e.g. a 10KB string in a 10M-row column costs ~100GB); "
+    "this turns that OOM into a diagnosable error naming the column.  "
+    "Shrink reader.batchSizeRows, filter/substring the column, or "
+    "raise this limit").int_conf(2 << 30)
+
 # --- string cast gates (reference: RapidsConf.scala:373-403) --------------
 CAST_STRING_TO_INTEGER = conf(
     "spark.rapids.tpu.sql.castStringToInteger.enabled").doc(

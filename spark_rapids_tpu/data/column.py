@@ -456,10 +456,18 @@ def _packing_ok() -> bool:
 
 
 def host_to_device(batch: HostBatch, min_bucket_rows: int = 128,
-                   device=None, string_widths=None) -> DeviceBatch:
+                   device=None, string_widths=None,
+                   string_guard_bytes: int = 0) -> DeviceBatch:
     """``string_widths``: optional col-index -> byte-matrix width map so
     several uploads share static string shapes (mesh stacking needs
-    every shard's columns shape-equal)."""
+    every shard's columns shape-equal).
+
+    ``string_guard_bytes`` > 0 fails the upload when any string
+    column's byte matrix (padded rows x max encoded length) would
+    exceed that size — byte-matrix HBM scales with the ONE longest
+    string, so a pathological value silently multiplies the batch
+    footprint; better a diagnosable error naming the column than an
+    opaque device OOM (conf: stringColumnBytesGuard)."""
     import jax
     import jax.numpy as jnp
 
@@ -475,6 +483,17 @@ def host_to_device(batch: HostBatch, min_bucket_rows: int = 128,
         if c.dtype.id is TypeId.STRING:
             width = (string_widths or {}).get(ci)
             bm, ln = dstrings.encode(c.data, c.validity, max_len=width)
+            if string_guard_bytes > 0 \
+                    and padded * bm.shape[1] > string_guard_bytes:
+                raise RuntimeError(
+                    f"string column '{batch.schema.names[ci]}' would "
+                    f"need a {padded} x {bm.shape[1]} byte matrix "
+                    f"({padded * bm.shape[1] / 1e9:.2f} GB) on device, "
+                    "over the guard (spark.rapids.tpu.sql."
+                    "stringColumnBytesGuard). Shrink "
+                    "spark.rapids.tpu.sql.reader.batchSizeRows, filter "
+                    "or substring the column earlier, or raise the "
+                    "guard.")
             bm, ln = dstrings.pad_rows(bm, ln, padded)
             arrays.extend([bm, validity, ln])
             spec.append(True)

@@ -8,9 +8,10 @@ upload (the reference acquires just before GPU decode,
 GpuParquetScan.scala:554)."""
 from __future__ import annotations
 
-from ..data.column import device_to_host, host_to_device
+from ..data.column import bucket_rows, device_to_host, host_to_device
 from ..config import (BUCKET_MIN_ROWS, READER_BATCH_SIZE_BYTES,
-                      READER_BATCH_SIZE_ROWS, READER_PREFETCH_BATCHES)
+                      READER_BATCH_SIZE_ROWS, READER_PREFETCH_BATCHES,
+                      STRING_COLUMN_BYTES_GUARD)
 from ..plan.physical import PartitionedData
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
@@ -98,12 +99,15 @@ class HostToDeviceExec(TpuExec):
                 store = caches[key] = {}
                 weakref.finalize(self, _free_cached_uploads, fw, store)
 
+        str_guard = ctx.conf.get(STRING_COLUMN_BYTES_GUARD)
+
         def upload(hb):
             if sem:
                 sem.acquire_if_necessary()
             with trace_range("HostToDevice",
                              self.metrics[M.TOTAL_TIME]):
-                db = host_to_device(hb, min_rows)
+                db = host_to_device(hb, min_rows,
+                                    string_guard_bytes=str_guard)
             self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
             self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
             return db

@@ -266,3 +266,20 @@ def test_write_then_query_pipeline(tmp_path, mixed_df_data):
     exp = q(srt.Session(tpu_enabled=False)).collect()
     assert_rows_equal(exp, got, ignore_order=True,
                       approximate_float=1e-9)
+
+
+def test_string_column_bytes_guard():
+    """A pathological long string must fail the upload with a
+    diagnosable error naming the column, not an opaque device OOM
+    (byte-matrix HBM = rows x max_len)."""
+    sess = srt.Session(
+        {"spark.rapids.tpu.sql.stringColumnBytesGuard": 1 << 20})
+    big = "x" * 20_000
+    df = sess.create_dataframe(
+        {"s": [big] + ["tiny"] * 200, "v": list(range(201))})
+    with pytest.raises(RuntimeError, match="stringColumnBytesGuard"):
+        df.filter(df["v"] > 10).collect()
+    # default guard admits normal data
+    ok = srt.Session().create_dataframe(
+        {"s": ["tiny"] * 50, "v": list(range(50))})
+    assert len(ok.filter(ok["v"] >= 0).collect()) == 50
