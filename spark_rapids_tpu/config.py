@@ -132,7 +132,40 @@ def dump_markdown() -> str:
         if e.is_internal:
             continue
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
+    lines += ["", _MEMORY_ROBUSTNESS_DOC]
     return "\n".join(lines)
+
+
+_MEMORY_ROBUSTNESS_DOC = """\
+## Memory-pressure robustness
+
+On a fixed-HBM TPU, memory pressure is the steady state, not the
+exception.  Device operators route every allocation-heavy attempt
+through the OOM retry framework (`spark_rapids_tpu/memory/retry.py`):
+
+* **retry** (`TpuRetryOOM`): the allocation failed but may succeed once
+  memory is freed.  The task releases its device-semaphore permits,
+  forces a synchronous spill through the spill framework, backs off
+  with a bounded exponential delay plus seeded jitter
+  (`retry.backoffBaseMs` / `retry.backoffMaxMs` / `retry.backoffSeed`),
+  and re-executes the attempt from its checkpointed input — up to
+  `retry.maxRetries` times.
+* **split-and-retry** (`TpuSplitAndRetryOOM`): retrying the same input
+  cannot succeed; the input batch is halved by rows — recursively, down
+  to the `retry.minSplitRows` floor — and each piece is processed
+  independently (upload, stream-side joins, aggregate and sort compose
+  per-piece results back into the unsplit answer).  An OOM at the floor
+  is genuine and surfaces with a diagnostic naming the operator.
+
+Recovery is observable: per-query counters `retry.numRetries`,
+`retry.numSplitRetries`, `retry.retryBlockTimeMs` and
+`retry.spillBytesOnRetry` land in `Session.last_metrics`, and a
+degraded query logs a summary when `spark.rapids.tpu.sql.trace.enabled`
+is on.
+
+The `oomInjection.*` confs (table above) drive any operator path
+through its OOM-recovery path deterministically in CI on CPU-only JAX —
+no real memory exhaustion required."""
 
 
 # ==========================================================================
@@ -148,6 +181,49 @@ HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.tpu.memory.host.spillStorageSize").
     1024 * 1024 * 1024)
 DEVICE_MEMORY_DEBUG = conf("spark.rapids.tpu.memory.debug").doc(
     "Log device allocations/frees").boolean_conf(False)
+
+# --- OOM retry / split-and-retry (memory/retry.py; reference:
+# RmmRapidsRetryIterator + the RMM OOM-injection test mode) ----------------
+RETRY_MAX_RETRIES = conf("spark.rapids.tpu.memory.retry.maxRetries").doc(
+    "OOM retries of one attempt (spill + backoff + re-execute) before a "
+    "split-capable operator halves its input instead; non-splittable "
+    "operators surface the OOM after this many retries").int_conf(8)
+RETRY_MIN_SPLIT_ROWS = conf("spark.rapids.tpu.memory.retry.minSplitRows").doc(
+    "Split-and-retry floor: an input batch is never split below this "
+    "many rows — an OOM at the floor is genuine and surfaces with a "
+    "diagnostic naming the operator").int_conf(1)
+RETRY_BACKOFF_BASE_MS = conf("spark.rapids.tpu.memory.retry.backoffBaseMs").doc(
+    "Base delay of the bounded exponential backoff between OOM retries, "
+    "milliseconds (delay = min(base * 2^attempt, backoffMaxMs) with "
+    "seeded jitter)").double_conf(2.0)
+RETRY_BACKOFF_MAX_MS = conf("spark.rapids.tpu.memory.retry.backoffMaxMs").doc(
+    "Upper bound on the exponential backoff delay between OOM retries, "
+    "milliseconds").double_conf(200.0)
+RETRY_BACKOFF_SEED = conf("spark.rapids.tpu.memory.retry.backoffSeed").doc(
+    "Seed for the backoff jitter (decorrelates tasks that OOMed "
+    "together without making test timings nondeterministic)").int_conf(0)
+
+# --- deterministic OOM injection (test mode; reference: RMM's
+# oomInjection / RmmSpark.forceRetryOOM) -----------------------------------
+OOM_INJECTION_MODE = conf("spark.rapids.tpu.memory.oomInjection.mode").doc(
+    "Fault-injection mode driving operators through their OOM-recovery "
+    "paths without real memory exhaustion: none (off), nth (fire once "
+    "at allocation checkpoint #skipCount), random (seeded probabilistic "
+    "firing, suppressed during recovery so progress is guaranteed), "
+    "always (fire at every checkpoint — proves split-retry bottoms out "
+    "at retry.minSplitRows)").string_conf("none")
+OOM_INJECTION_SKIP_COUNT = conf(
+    "spark.rapids.tpu.memory.oomInjection.skipCount").doc(
+    "mode=nth: 0-based allocation checkpoint at which the single "
+    "injected OOM fires; sweeping 0..N drives every checkpoint of a "
+    "pipeline through recovery, one run at a time").int_conf(0)
+OOM_INJECTION_SEED = conf("spark.rapids.tpu.memory.oomInjection.seed").doc(
+    "Seed for mode=random's injection decisions (deterministic given "
+    "a fixed checkpoint order)").int_conf(0)
+OOM_INJECTION_TYPE = conf("spark.rapids.tpu.memory.oomInjection.oomType").doc(
+    "Type of injected OOM: retry (TpuRetryOOM — spill+backoff+retry) or "
+    "split (TpuSplitAndRetryOOM — the input batch must be halved)"
+).string_conf("retry")
 
 # --- scheduling -----------------------------------------------------------
 CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(

@@ -19,6 +19,7 @@ from typing import List
 
 from .. import types as T
 from ..data.column import DeviceBatch, DeviceColumn
+from ..memory import retry as R
 from ..ops.aggregates import AggregateFunction
 from ..ops.expression import BoundReference, as_device_column
 from ..ops.kernels import gather as G
@@ -295,28 +296,73 @@ class TpuHashAggregateExec(TpuExec):
         return DeviceBatch(self._schema, out_cols, n_real)
 
     # ------------------------------------------------------------------
-    def _agg_chunked(self, first: DeviceBatch, rest) -> DeviceBatch:
+    def _to_buffers_fn(self):
+        """Buffer-form transform of one raw input piece (identity for
+        ``final`` mode, whose input already IS buffer form), with an
+        OOM-injection checkpoint at the attempt boundary."""
+        inner = (lambda b: b) if self.mode == "final" \
+            else self._update_kernel
+
+        def fn(b):
+            R.maybe_inject_oom("TpuHashAggregate.update")
+            return inner(b)
+
+        return fn
+
+    def _agg_chunked(self, first: DeviceBatch, rest,
+                     rctx) -> DeviceBatch:
         """Out-of-core path: per-batch buffer-form agg + running merge
         (reference: aggregate.scala:240-335 concat+merge loop).  The
         running result sits in the spill catalog between merges so the
         alloc-pressure handler can evict it while the next input batch
-        is being produced/aggregated."""
+        is being produced/aggregated.  Each per-batch pass runs through
+        the retry framework: an OOM retries after spill+backoff, a
+        split request halves the input batch — buffer forms of the
+        pieces merge into the running result exactly like whole
+        batches."""
+        from itertools import chain
+
         from ..memory.spill import SpillFramework, SpillPriorities
         from .coalesce import concat_device_batches
 
         fw = SpillFramework.get()
-        to_buffers = (lambda b: b) if self.mode == "final" \
-            else self._update_kernel
-        running = to_buffers(first)
-        for nxt in rest:
-            rid = fw.add_batch(running,
-                               priority=SpillPriorities.ACTIVE_ON_DECK)
-            part = to_buffers(nxt)
-            run_dev = fw.acquire_batch(rid)
-            combined = concat_device_batches([run_dev, part])
-            fw.release_batch(rid)
-            fw.remove_batch(rid)
-            running = self._merge_kernel(combined)
+        to_buffers = self._to_buffers_fn()
+
+        running = None  # merged buffer form so far (device batch)
+        rid = None      # spill-catalog id while running is parked
+
+        def park():
+            # running sits in the spill catalog while the NEXT piece is
+            # being produced/aggregated, so pressure can evict it
+            nonlocal rid
+            if running is not None and rid is None:
+                rid = R.retry_call(
+                    lambda: fw.add_batch(
+                        running,
+                        priority=SpillPriorities.ACTIVE_ON_DECK),
+                    rctx)
+
+        def unpark():
+            nonlocal rid, running
+            if rid is not None:
+                running = R.retry_call(
+                    lambda: fw.acquire_batch(rid), rctx)
+                fw.release_batch(rid)
+                fw.remove_batch(rid)
+                rid = None
+
+        for nxt in chain([first], rest):
+            park()
+            for part in R.with_split_retry(nxt, to_buffers, ctx=rctx):
+                unpark()
+                if running is None:
+                    running = part
+                else:
+                    combined = concat_device_batches([running, part])
+                    running = R.retry_call(
+                        lambda c=combined: self._merge_kernel(c), rctx)
+                park()
+        unpark()
         if self.mode == "partial":
             return running
         # re-merging the grouped running result is the identity on every
@@ -324,9 +370,28 @@ class TpuHashAggregateExec(TpuExec):
         # applies the finalize expressions
         return self._merge_final_kernel(running)
 
+    def _agg_split(self, batch: DeviceBatch, rctx) -> DeviceBatch:
+        """Split-and-retry escalation for the single-batch path: halve
+        the input, aggregate each piece to buffer form (recursively
+        splittable), then merge — the same composition the chunked
+        out-of-core path uses, so results match the unsplit kernel."""
+        from .coalesce import concat_device_batches
+
+        to_buffers = self._to_buffers_fn()
+        running = None
+        for part in R.with_split_retry(batch, to_buffers, ctx=rctx,
+                                       initial_split=True):
+            running = part if running is None else R.retry_call(
+                lambda c=concat_device_batches([running, part]):
+                self._merge_kernel(c), rctx)
+        if self.mode == "partial":
+            return running
+        return self._merge_final_kernel(running)
+
     def execute_columnar(self, ctx):
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
+        rctx = R.RetryContext.for_exec(ctx, "TpuHashAggregateExec")
 
         def make(pid):
             def it():
@@ -342,15 +407,34 @@ class TpuHashAggregateExec(TpuExec):
                     first = host_to_device(
                         _empty_batch(self.children[0].schema))
                 second = next(batches, None)
+
+                def agg_full(b):
+                    R.maybe_inject_oom("TpuHashAggregate")
+                    return self._kernel(b)
+
                 with trace_range("TpuHashAggregate",
                                  self.metrics[M.TOTAL_TIME]):
                     if second is None:
-                        out = self._kernel(first)
+                        try:
+                            # allow_split: a genuine OOM that exhausts
+                            # its retries escalates to the split path
+                            # below instead of failing the task
+                            out = R.retry_call(
+                                lambda: agg_full(first), rctx,
+                                allow_split=True)
+                        except R.TpuSplitAndRetryOOM:
+                            if R.can_split(first, rctx):
+                                out = self._agg_split(first, rctx)
+                            else:
+                                # at the floor: plain retries (a split
+                                # request degrades inside retry_call)
+                                out = R.retry_call(
+                                    lambda: agg_full(first), rctx)
                     else:
                         from itertools import chain
 
                         out = self._agg_chunked(
-                            first, chain([second], batches))
+                            first, chain([second], batches), rctx)
                 self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
                 yield out
 

@@ -30,6 +30,7 @@ from typing import List
 import numpy as np
 
 from ..data.column import DeviceBatch, DeviceColumn
+from ..memory import retry as R
 from ..ops.expression import as_device_column
 from ..ops.kernels import segment as seg
 from ..ops.kernels.gather import compact
@@ -233,6 +234,14 @@ class TpuShuffleExchangeExec(TpuExec):
         # spill+promote cycle yields a new batch object and recomputes
         pid_cache: dict = {}
         fw = SpillFramework.get()
+        rctx = R.RetryContext.for_exec(ctx, "TpuShuffleExchangeExec")
+
+        def write_one(b):
+            # registering a map-output batch is the write-side
+            # allocation checkpoint; an OOM retries after spill+backoff
+            # (the batch itself is the checkpointed input)
+            R.maybe_inject_oom("TpuShuffleExchange.write")
+            return fw.add_batch(b)
 
         def _drain_child():
             import jax
@@ -279,7 +288,8 @@ class TpuShuffleExchangeExec(TpuExec):
                                  self.metrics[M.TOTAL_TIME]):
                     for pid in range(child.n_partitions):
                         for b in child.iterator(pid):
-                            buf_id = fw.add_batch(b)
+                            buf_id = R.retry_call(
+                                lambda b=b: write_one(b), rctx)
                             added.append(buf_id)
                             if catalog is not None:
                                 catalog.add_buffer(shuffle_id, pid,
@@ -416,7 +426,10 @@ class TpuShuffleExchangeExec(TpuExec):
                     outs.clear()
 
                 for buf_id, rr_start in materialized():
-                    b = fw.acquire_batch(buf_id)
+                    # promotion of a spilled map-output batch is an
+                    # allocation: route it through the retry framework
+                    b = R.retry_call(
+                        lambda bid=buf_id: fw.acquire_batch(bid), rctx)
                     try:
                         outs.append(self._slice_kernel(
                             b, pids_of(buf_id, b, rr_start),

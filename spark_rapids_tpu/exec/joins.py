@@ -22,6 +22,7 @@ import numpy as np
 
 from .. import types as T
 from ..data.column import DeviceBatch, bucket_rows
+from ..memory import retry as R
 from ..ops.cast import Cast
 from ..ops.expression import Expression, as_device_column
 from ..ops.kernels import join as J
@@ -152,7 +153,7 @@ class TpuHashJoinExec(TpuExec):
     _GRACE_MAX_LEVEL = 6
 
     def _join_grace(self, l_batches, r_batches, total_bytes: int,
-                    target: int, level: int = 0):
+                    target: int, level: int = 0, rctx=None):
         """Join sides too big for one batch pair: hash both into the same
         bucket space and join bucket-wise (the spill-aware analogue of the
         reference's RequireSingleBatch build side — which documents
@@ -214,12 +215,13 @@ class TpuHashJoinExec(TpuExec):
                 # rehashing cannot split equal keys, join directly)
                 pair_bytes = lb.device_bytes() + rb.device_bytes()
                 yield from self._join_grace([lb], [rb], pair_bytes,
-                                            target, level + 1)
+                                            target, level + 1, rctx)
             else:
                 lbp = pad_device_batch(lb, cap_l, l_widths)
                 rbp = pad_device_batch(rb, cap_r, r_widths)
-                yield self._metrics_wrap(
-                    lambda lbp=lbp, rbp=rbp: self._join(lbp, rbp))
+                yield R.retry_call(
+                    lambda lbp=lbp, rbp=rbp: self._metrics_wrap(
+                        lambda: self._join(lbp, rbp)), rctx)
 
     # ------------------------------------------------------------------
     def _keys_of(self, batch: DeviceBatch, exprs):
@@ -258,11 +260,29 @@ class TpuHashJoinExec(TpuExec):
         return compact(lb, keep)
 
     def _join(self, lb: DeviceBatch, rb: DeviceBatch) -> DeviceBatch:
+        # OOM-injection checkpoint: the join's working set is the pair
+        R.maybe_inject_oom(type(self).__name__ + ".join")
         if self.how in ("semi", "anti"):
             return self._semi_kernel(lb, rb)
         pr, emit, r_extra, total = self._count_kernel(lb, rb)
         c_out = bucket_rows(int(total))  # host sync: output sizing
         return self._expand_kernel(c_out, lb, rb, pr, emit, r_extra)
+
+    #: join types whose stream (left) side is row-local — every output
+    #: row depends on one left row plus the whole build side — so the
+    #: stream batch can be split by rows under memory pressure and the
+    #: piece results concatenated (right/full track build-side match
+    #: state across ALL stream rows and must not be split)
+    _STREAM_SPLITTABLE = ("inner", "left", "semi", "anti")
+
+    def _join_stream_retry(self, lb: DeviceBatch, rb: DeviceBatch, rctx):
+        """Join one stream batch against the (held) build batch through
+        the retry framework, splitting the stream side when allowed."""
+        fn = lambda l: self._metrics_wrap(lambda: self._join(l, rb))  # noqa: E731
+        if self.how in self._STREAM_SPLITTABLE:
+            yield from R.with_split_retry(lb, fn, ctx=rctx)
+        else:
+            yield R.retry_call(lambda: fn(lb), rctx)
 
     def join_static(self, lb: DeviceBatch, rb: DeviceBatch, c_out: int):
         """Trace-safe join with a fixed output capacity (no host sync) —
@@ -309,6 +329,7 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
         assert left.n_partitions == right.n_partitions, \
             "shuffled join requires co-partitioned children"
         target = ctx.conf.batch_size_bytes
+        rctx = R.RetryContext.for_exec(ctx, type(self).__name__)
 
         def make(pid):
             def it():
@@ -319,10 +340,10 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
                 if len(l_batches) <= 1 and len(r_batches) <= 1:
                     lb = self._of(l_batches, 0)
                     rb = self._of(r_batches, 1)
-                    yield self._metrics_wrap(lambda: self._join(lb, rb))
+                    yield from self._join_stream_retry(lb, rb, rctx)
                     return
                 yield from self._join_grace(l_batches, r_batches,
-                                            total, target)
+                                            total, target, rctx=rctx)
 
             return it
 
@@ -389,6 +410,8 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
 
             return host_to_device(_empty_batch(self.children[1].schema))
 
+        rctx = R.RetryContext.for_exec(ctx, type(self).__name__)
+
         def make(pid):
             def it():
                 art = reg.get_or_build(key, build_batch,
@@ -396,18 +419,20 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
                 streamed = False
                 for lb in left.iterator(pid):
                     streamed = True
-                    rb = art.acquire()  # lazy re-upload if spilled
+                    # lazy re-upload if spilled — a promotion is an
+                    # allocation, so it recovers via spill+backoff
+                    rb = R.retry_call(art.acquire, rctx)
                     try:
-                        yield self._metrics_wrap(
-                            lambda: self._join(lb, rb))
+                        yield from self._join_stream_retry(lb, rb, rctx)
                     finally:
                         art.release()
                 if not streamed:
                     lb = self._one_batch_empty(0)
-                    rb = art.acquire()
+                    rb = R.retry_call(art.acquire, rctx)
                     try:
-                        yield self._metrics_wrap(
-                            lambda: self._join(lb, rb))
+                        yield R.retry_call(
+                            lambda: self._metrics_wrap(
+                                lambda: self._join(lb, rb)), rctx)
                     finally:
                         art.release()
 

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..data.column import (DeviceBatch, bucket_rows, device_to_host,
                            slice_device_batch)
+from ..memory import retry as R
 from ..ops.expression import as_device_column, as_host_column
 from ..ops.kernels import gather as G
 from ..ops.kernels import segment as seg
@@ -89,7 +90,7 @@ class TpuSortExec(TpuExec):
                 for k in self.keys]
 
     def _make_tiles(self, sorted_run: DeviceBatch, tile_rows: int,
-                    fw) -> List[_Tile]:
+                    fw, rctx) -> List[_Tile]:
         from ..memory.spill import SpillPriorities
 
         n = int(sorted_run.num_rows)
@@ -99,8 +100,10 @@ class TpuSortExec(TpuExec):
             tile = slice_device_batch(sorted_run, start, stop)
             last = device_to_host(slice_device_batch(sorted_run,
                                                      stop - 1, stop, 1))
-            buf_id = fw.add_batch(
-                tile, priority=SpillPriorities.output_for_read())
+            buf_id = R.retry_call(
+                lambda t=tile: fw.add_batch(
+                    t, priority=SpillPriorities.output_for_read()),
+                rctx)
             tiles.append(_Tile(buf_id, last, self._host_key_cols(last)))
         return tiles
 
@@ -183,9 +186,17 @@ class TpuSortExec(TpuExec):
         if int(carry.num_rows) > 0:
             yield self._kernel(carry)
 
-    def _sort_chunked(self, batches):
+    def _sort_one(self, b: DeviceBatch) -> DeviceBatch:
+        """Sort one batch, with an OOM-injection checkpoint at the
+        attempt boundary (the retryable unit)."""
+        R.maybe_inject_oom("TpuSort")
+        return self._kernel(b)
+
+    def _sort_chunked(self, batches, rctx):
         """Out-of-core path: sort each batch into a tiled run, then
-        stream the k-way merge."""
+        stream the k-way merge.  A batch too big to sort in one go is
+        halved by the retry framework — each sorted piece simply becomes
+        its own run, and the k-way merge restores the total order."""
         from ..memory.spill import SpillFramework
 
         fw = SpillFramework.get()
@@ -193,19 +204,20 @@ class TpuSortExec(TpuExec):
         tile_rows = None
         pending_first = None  # first run stays whole until a second shows
         for b in batches:
-            s = self._kernel(b)
-            if int(s.num_rows) == 0:
-                continue
-            if pending_first is None and not runs:
-                pending_first = s
-                continue
-            if pending_first is not None:
-                tile_rows = bucket_rows(
-                    max(1, int(pending_first.num_rows) // 4))
-                runs.append(deque(self._make_tiles(pending_first,
-                                                   tile_rows, fw)))
-                pending_first = None
-            runs.append(deque(self._make_tiles(s, tile_rows, fw)))
+            for s in R.with_split_retry(b, self._sort_one, ctx=rctx):
+                if int(s.num_rows) == 0:
+                    continue
+                if pending_first is None and not runs:
+                    pending_first = s
+                    continue
+                if pending_first is not None:
+                    tile_rows = bucket_rows(
+                        max(1, int(pending_first.num_rows) // 4))
+                    runs.append(deque(self._make_tiles(
+                        pending_first, tile_rows, fw, rctx)))
+                    pending_first = None
+                runs.append(deque(self._make_tiles(s, tile_rows, fw,
+                                                   rctx)))
         if pending_first is not None:
             yield pending_first
             return
@@ -216,6 +228,7 @@ class TpuSortExec(TpuExec):
     def execute_columnar(self, ctx):
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
+        rctx = R.RetryContext.for_exec(ctx, "TpuSortExec")
 
         def make(pid):
             def it():
@@ -227,12 +240,30 @@ class TpuSortExec(TpuExec):
                 with trace_range("TpuSort",
                                  self.metrics[M.TOTAL_TIME]):
                     if second is None:
-                        out = [self._kernel(first)]
+                        try:
+                            # allow_split: a genuine OOM that exhausts
+                            # its retries escalates to the external
+                            # merge below instead of failing the task
+                            out = [R.retry_call(
+                                lambda: self._sort_one(first), rctx,
+                                allow_split=True)]
+                        except R.TpuSplitAndRetryOOM:
+                            if R.can_split(first, rctx):
+                                # halve and route through the external
+                                # merge: each half is a sorted run
+                                halves = R.split_or_raise(first, rctx)
+                                out = self._sort_chunked(halves, rctx)
+                            else:
+                                # at the floor: plain retries (a split
+                                # request degrades inside retry_call)
+                                out = [R.retry_call(
+                                    lambda: self._sort_one(first),
+                                    rctx)]
                     else:
                         from itertools import chain
 
                         out = self._sort_chunked(
-                            chain([first, second], batches))
+                            chain([first, second], batches), rctx)
                 for b in out:
                     self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
                     yield b

@@ -12,6 +12,7 @@ from ..data.column import bucket_rows, device_to_host, host_to_device
 from ..config import (BUCKET_MIN_ROWS, READER_BATCH_SIZE_BYTES,
                       READER_BATCH_SIZE_ROWS, READER_PREFETCH_BATCHES,
                       STRING_COLUMN_BYTES_GUARD)
+from ..memory import retry as R
 from ..plan.physical import PartitionedData
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
@@ -100,10 +101,12 @@ class HostToDeviceExec(TpuExec):
                 weakref.finalize(self, _free_cached_uploads, fw, store)
 
         str_guard = ctx.conf.get(STRING_COLUMN_BYTES_GUARD)
+        rctx = R.RetryContext.for_exec(ctx, "HostToDeviceExec")
 
         def upload(hb):
             if sem:
                 sem.acquire_if_necessary()
+            R.maybe_inject_oom("HostToDeviceExec.upload")
             with trace_range("HostToDevice",
                              self.metrics[M.TOTAL_TIME]):
                 db = host_to_device(hb, min_rows,
@@ -111,6 +114,12 @@ class HostToDeviceExec(TpuExec):
             self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
             self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
             return db
+
+        def upload_retry(hb):
+            # an upload that OOMs is retried after spill+backoff; a
+            # split request halves the host batch (down to the
+            # minSplitRows floor) and uploads the pieces in row order
+            return R.with_split_retry(hb, upload, ctx=rctx)
 
         def make(pid):
             def it_cached():
@@ -123,7 +132,11 @@ class HostToDeviceExec(TpuExec):
                     for buf_id, n_rows in store[pid]:
                         if sem:
                             sem.acquire_if_necessary()
-                        b = fw.acquire_batch(buf_id)  # promote if spilled
+                        # promote if spilled (a promotion is an
+                        # allocation: OOMs recover via spill+backoff)
+                        b = R.retry_call(
+                            lambda bid=buf_id: fw.acquire_batch(bid),
+                            rctx)
                         if held is not None:
                             fw.release_batch(held)
                         held = buf_id
@@ -146,7 +159,8 @@ class HostToDeviceExec(TpuExec):
                 complete = False
                 try:
                     for db in inner:
-                        ids.append(fw.add_batch(db))
+                        ids.append(R.retry_call(
+                            lambda d=db: fw.add_batch(d), rctx))
                         nrs.append(db.num_rows)
                         yield db
                     complete = True
@@ -168,7 +182,7 @@ class HostToDeviceExec(TpuExec):
                 for batch in child_data.iterator(pid):
                     for hb in _split_host_batch(batch, max_rows,
                                                 max_bytes):
-                        yield upload(hb)
+                        yield from upload_retry(hb)
 
             def it_pipelined():
                 # decode/upload overlap: a host-only producer thread
@@ -226,7 +240,7 @@ class HostToDeviceExec(TpuExec):
                             break
                         if isinstance(item, BaseException):
                             raise item
-                        yield upload(item)
+                        yield from upload_retry(item)
                 finally:
                     stop.set()
 

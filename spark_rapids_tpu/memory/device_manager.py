@@ -106,13 +106,32 @@ class DeviceManager:
     def track_alloc(self, nbytes: int) -> None:
         """Record a device allocation; fires the event handler (spill) when
         the logical arena would overflow (reference:
-        DeviceMemoryEventHandler.onAllocFailure)."""
+        DeviceMemoryEventHandler.onAllocFailure).
+
+        Raises :class:`~.retry.TpuRetryOOM` — the typed signal the retry
+        framework recovers from — when the arena is over budget and the
+        spill handler could not free anything (everything pinned); the
+        allocation is rolled back so a retried attempt re-tracks it.
+        Also an OOM-injection checkpoint (fires BEFORE any accounting)."""
+        from .retry import TpuRetryOOM, maybe_inject_oom
+
+        maybe_inject_oom("DeviceManager.track_alloc", nbytes)
         with self._alloc_lock:
             self._allocated += nbytes
             self._peak = max(self._peak, self._allocated)
             over = self._allocated - self.arena_bytes
         if over > 0 and self.event_handler is not None:
-            self.event_handler.on_alloc_threshold(over)
+            freed = self.event_handler.on_alloc_threshold(over)
+            with self._alloc_lock:
+                still_over = self._allocated - self.arena_bytes
+            if still_over > 0 and not freed:
+                with self._alloc_lock:
+                    self._allocated = max(0, self._allocated - nbytes)
+                raise TpuRetryOOM(
+                    f"device arena exhausted: allocation of {nbytes} "
+                    f"bytes leaves usage {still_over} bytes over the "
+                    f"{self.arena_bytes}-byte arena and nothing could "
+                    "be spilled (all device buffers pinned)")
         if self.debug:
             log.info("alloc %d (total %d)", nbytes, self._allocated)
 

@@ -1,0 +1,598 @@
+"""OOM-aware retry & split-and-retry framework.
+
+Reference analogue: the successor lineage's ``RmmRapidsRetryIterator``
+with its typed ``GpuRetryOOM`` / ``GpuSplitAndRetryOOM`` exceptions and
+the RMM OOM-injection test mode (``RmmSpark.forceRetryOOM``).  On a
+fixed-HBM TPU, memory pressure is the steady state — this module is the
+task-level recovery protocol every device operator funnels through:
+
+* :class:`TpuRetryOOM` — the allocation failed but may succeed once
+  memory is freed: release the task's device-semaphore permits, force a
+  synchronous spill through the :class:`~.spill.SpillFramework`, back
+  off (bounded exponential delay + seeded jitter) and re-execute the
+  attempt from its checkpointed input.
+* :class:`TpuSplitAndRetryOOM` — retrying the same input cannot succeed;
+  the input batch must be SPLIT (halved by rows, recursively, down to a
+  configurable ``retry.minSplitRows`` floor) and each piece processed
+  independently.
+
+The combinators are :func:`with_retry` (iterator form), :func:`retry_call`
+(single-call form) and :func:`with_split_retry` (split-capable form over
+one batch).  All of them route recovery through :meth:`RetryContext.
+recover`, which records the per-task retry metrics (``numRetries``,
+``numSplitRetries``, ``retryBlockTimeMs``, ``spillBytesOnRetry``) into
+the query's metrics registry so a degraded query is visibly degraded.
+
+Deterministic fault injection: :class:`OomInjector` (confs
+``spark.rapids.tpu.memory.oomInjection.{mode,skipCount,seed,oomType}``)
+is consulted by :func:`maybe_inject_oom`, which the hot operators and
+``DeviceManager.track_alloc`` call at every allocation checkpoint — so
+any operator path can be driven through its OOM-recovery path in CI on
+CPU-only JAX, without real memory exhaustion.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Iterator, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+# ==========================================================================
+# Typed OOM exceptions (reference: GpuRetryOOM / GpuSplitAndRetryOOM)
+# ==========================================================================
+class TpuRetryOOM(MemoryError):
+    """A device allocation failed under pressure; the attempt should be
+    retried from its checkpointed input after spilling + backoff."""
+
+    def __init__(self, *args, injected: bool = False):
+        super().__init__(*args)
+        #: True when raised by the fault injector (test mode) rather
+        #: than by real arena exhaustion
+        self.injected = injected
+
+
+class TpuSplitAndRetryOOM(TpuRetryOOM):
+    """Retrying the same input cannot succeed — the input batch must be
+    split and each piece retried independently."""
+
+
+# ==========================================================================
+# Deterministic OOM injection
+# ==========================================================================
+#: soft suppression depth: >0 while a combinator re-executes a failed
+#: attempt.  ``random`` mode skips injection here so a retry can always
+#: make progress; ``always`` mode keeps firing (that IS its point —
+#: driving split-retry to the minSplitRows floor), ``nth`` is one-shot
+#: by construction.
+_tl = threading.local()
+
+
+def _recovery_depth() -> int:
+    return getattr(_tl, "recovery", 0)
+
+
+def _shield_depth() -> int:
+    return getattr(_tl, "shield", 0)
+
+
+class _shield:
+    """Hard-off injection guard for framework internals (checkpointing,
+    spilling during recovery) — even ``always`` mode must not fire while
+    the recovery machinery itself allocates."""
+
+    def __enter__(self):
+        _tl.shield = _shield_depth() + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tl.shield = _shield_depth() - 1
+
+
+class _recovering:
+    def __enter__(self):
+        _tl.recovery = _recovery_depth() + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tl.recovery = _recovery_depth() - 1
+
+
+class OomInjector:
+    """Deterministic allocation-failure injector (reference: the RMM
+    OOM-injection mode behind ``RmmSpark.forceRetryOOM`` /
+    ``forceSplitAndRetryOOM``).
+
+    Modes (``spark.rapids.tpu.memory.oomInjection.mode``):
+
+    * ``none``   — disabled (production default).
+    * ``nth``    — fire exactly ONCE, at global allocation checkpoint
+      number ``skipCount`` (0-based), then disarm.  Sweeping skipCount
+      0..N drives an OOM through every checkpoint of a pipeline, one
+      run at a time — each run must still produce bit-identical results.
+    * ``random`` — fire with a seeded pseudo-random probability at each
+      checkpoint, but never while a combinator is re-executing a failed
+      attempt (so recovery always makes progress).
+    * ``always`` — fire at EVERY checkpoint, including retries.  Only
+      useful to prove the bottom-out path: split-retry must halve down
+      to ``retry.minSplitRows`` and then surface a diagnostic.
+
+    ``oomType`` selects the raised type: ``retry`` -> TpuRetryOOM,
+    ``split`` -> TpuSplitAndRetryOOM.
+    """
+
+    #: injection probability for mode=random (seeded, see ``seed``)
+    RANDOM_PROBABILITY = 0.25
+
+    def __init__(self, mode: str = "none", skip_count: int = 0,
+                 seed: int = 0, oom_type: str = "retry"):
+        mode = (mode or "none").lower()
+        if mode not in ("none", "always", "nth", "random"):
+            raise ValueError(
+                f"oomInjection.mode must be none|always|nth|random, "
+                f"got {mode!r}")
+        oom_type = (oom_type or "retry").lower()
+        if oom_type not in ("retry", "split"):
+            raise ValueError(
+                f"oomInjection.oomType must be retry|split, "
+                f"got {oom_type!r}")
+        self.mode = mode
+        self.skip_count = max(0, int(skip_count))
+        self.seed = int(seed)
+        self.oom_type = oom_type
+        self._rng = random.Random(self.seed)
+        self._count = 0
+        self._armed = True
+        self._injected = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_conf(cls, conf) -> "OomInjector":
+        from ..config import (OOM_INJECTION_MODE, OOM_INJECTION_SEED,
+                              OOM_INJECTION_SKIP_COUNT, OOM_INJECTION_TYPE)
+
+        return cls(mode=conf.get(OOM_INJECTION_MODE),
+                   skip_count=conf.get(OOM_INJECTION_SKIP_COUNT),
+                   seed=conf.get(OOM_INJECTION_SEED),
+                   oom_type=conf.get(OOM_INJECTION_TYPE))
+
+    @property
+    def checkpoints_seen(self) -> int:
+        return self._count
+
+    @property
+    def injections_fired(self) -> int:
+        return self._injected
+
+    def check(self, site: str = "") -> None:
+        """One allocation checkpoint; raises the configured OOM type when
+        the injection policy says this one fails."""
+        if self.mode == "none" or _shield_depth() > 0:
+            return
+        if self.mode == "random" and _recovery_depth() > 0:
+            return
+        with self._lock:
+            n = self._count
+            self._count += 1
+            if self.mode == "always":
+                fire = True
+            elif self.mode == "nth":
+                fire = self._armed and n == self.skip_count
+                if fire:
+                    self._armed = False
+            else:  # random
+                fire = self._rng.random() < self.RANDOM_PROBABILITY
+            if fire:
+                self._injected += 1
+        if fire:
+            exc = TpuSplitAndRetryOOM if self.oom_type == "split" \
+                else TpuRetryOOM
+            raise exc(
+                f"injected OOM (mode={self.mode}, checkpoint #{n}, "
+                f"site={site or '?'})", injected=True)
+
+
+#: process-wide injector, (re)installed at query start from the query's
+#: conf (ExecContext) — per-query so a skipCount sweep resets its
+#: checkpoint counter every run
+_injector_lock = threading.Lock()
+_injector: Optional[OomInjector] = None
+
+
+def install_injector(inj: Optional[OomInjector]) -> None:
+    global _injector
+    with _injector_lock:
+        _injector = inj
+
+
+def get_injector() -> Optional[OomInjector]:
+    return _injector
+
+
+def maybe_inject_oom(site: str = "", nbytes: int = 0) -> None:
+    """Allocation checkpoint hook: called by ``DeviceManager.track_alloc``
+    and by the hot operators at the top of each retryable attempt."""
+    inj = _injector
+    if inj is not None:
+        inj.check(site)
+
+
+# ==========================================================================
+# Backoff
+# ==========================================================================
+def backoff_delay_s(attempt: int, base_ms: float = 2.0,
+                    max_ms: float = 200.0,
+                    rng: Optional[random.Random] = None) -> float:
+    """Bounded exponential backoff with jitter, in SECONDS.  attempt is
+    0-based; delay = min(base * 2^attempt, max) * U[0.5, 1.0) — the
+    jitter decorrelates tasks that OOMed together so their retries don't
+    re-contend in lockstep."""
+    capped = min(float(base_ms) * (2.0 ** max(0, attempt)), float(max_ms))
+    u = rng.random() if rng is not None else random.random()
+    return capped * (0.5 + 0.5 * u) / 1000.0
+
+
+# ==========================================================================
+# Split helpers
+# ==========================================================================
+def _num_rows(batch) -> int:
+    return int(batch.num_rows)
+
+
+def halve_rows(batch) -> List:
+    """Split a Host/Device batch in half by rows (order-preserving).
+    The default ``split`` policy of :func:`with_split_retry`."""
+    n = _num_rows(batch)
+    mid = max(1, n // 2)
+    from ..data.column import DeviceBatch, slice_device_batch
+
+    if isinstance(batch, DeviceBatch):
+        return [slice_device_batch(batch, 0, mid),
+                slice_device_batch(batch, mid, n)]
+    return [batch.slice(0, mid), batch.slice(mid, n)]
+
+
+# ==========================================================================
+# Retry context: conf + services + per-task metrics
+# ==========================================================================
+class RetryContext:
+    """Everything one task needs to recover from an OOM: the semaphore
+    to release, the spill framework to drain, backoff/limit confs, and
+    the query's retry metrics."""
+
+    def __init__(self, op_name: str = "", conf=None, semaphore=None,
+                 spill_framework=None, metrics=None):
+        self.op_name = op_name or "?"
+        self.semaphore = semaphore
+        self.spill_framework = spill_framework
+        from ..config import (RETRY_BACKOFF_BASE_MS, RETRY_BACKOFF_MAX_MS,
+                              RETRY_BACKOFF_SEED, RETRY_MAX_RETRIES,
+                              RETRY_MIN_SPLIT_ROWS, TpuConf)
+
+        conf = conf if conf is not None else TpuConf()
+        self.max_retries = max(1, conf.get(RETRY_MAX_RETRIES))
+        self.min_split_rows = max(1, conf.get(RETRY_MIN_SPLIT_ROWS))
+        self.backoff_base_ms = conf.get(RETRY_BACKOFF_BASE_MS)
+        self.backoff_max_ms = conf.get(RETRY_BACKOFF_MAX_MS)
+        self._rng = random.Random(conf.get(RETRY_BACKOFF_SEED))
+        # Metric objects (utils.metrics.Metric) or None
+        m = metrics or {}
+        self.num_retries = m.get("numRetries")
+        self.num_split_retries = m.get("numSplitRetries")
+        self.block_time_ms = m.get("retryBlockTimeMs")
+        self.spill_bytes = m.get("spillBytesOnRetry")
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def for_exec(cls, ctx, op_name: str) -> "RetryContext":
+        """Build from an ExecContext (plan/physical.py): services come
+        from the session, metrics from the query registry (names
+        ``retry.*`` so they land in ``Session.last_metrics``)."""
+        session = getattr(ctx, "session", None)
+        dm = getattr(session, "device_manager", None) if session else None
+        fw = getattr(session, "spill_framework", None) if session else None
+        from ..utils import metrics as M
+
+        reg = getattr(ctx, "metrics", None)
+        metrics = None
+        if reg is not None:
+            metrics = {
+                M.NUM_RETRIES: reg.metric("retry." + M.NUM_RETRIES),
+                M.NUM_SPLIT_RETRIES:
+                    reg.metric("retry." + M.NUM_SPLIT_RETRIES),
+                M.RETRY_BLOCK_TIME:
+                    reg.metric("retry." + M.RETRY_BLOCK_TIME, "ms"),
+                M.SPILL_BYTES_ON_RETRY:
+                    reg.metric("retry." + M.SPILL_BYTES_ON_RETRY),
+            }
+        return cls(op_name=op_name, conf=getattr(ctx, "conf", None),
+                   semaphore=dm.semaphore if dm is not None else None,
+                   spill_framework=fw, metrics=metrics)
+
+    # ------------------------------------------------------------------
+    def on_split(self) -> None:
+        if self.num_split_retries is not None:
+            self.num_split_retries.add(1)
+
+    def held_count(self) -> int:
+        sem = self.semaphore
+        return sem.held_count() if sem is not None else 0
+
+    def rewind_hold(self, count: int) -> None:
+        """Undo semaphore acquires made by a failed attempt (see
+        DeviceSemaphore.rewind_task)."""
+        if self.semaphore is not None:
+            self.semaphore.rewind_task(count)
+
+    def recover(self, attempt: int, pending: Optional[deque] = None,
+                restore_count: Optional[int] = None) -> None:
+        """The OOM recovery protocol (reference: RmmRapidsRetryIterator's
+        block-and-retry around RmmSpark.blockThreadUntilReady):
+
+        1. drop this task's device-semaphore permits so other tasks can
+           finish and free memory;
+        2. checkpoint any pending (not-yet-attempted) device batches into
+           the spill catalog so the spiller can evict them too;
+        3. force a synchronous spill of half the device tier;
+        4. back off with bounded exponential delay + seeded jitter;
+        5. re-enter device admission for the retry.
+        """
+        from ..utils.tracing import trace_range
+
+        start = time.perf_counter()
+        with trace_range(f"RetryRecover[{self.op_name}]"), _shield():
+            if self.num_retries is not None:
+                self.num_retries.add(1)
+            sem = self.semaphore
+            held = 0
+            if sem is not None:
+                # suspend (not collapse) the hold: the reentrancy count
+                # pairs with per-batch acquire/release streaming, so it
+                # must be restored exactly for later releases to unwind
+                # at the right point.  ``restore_count`` (the count
+                # BEFORE the failed attempt) drops acquires the attempt
+                # itself made — re-executing fn re-acquires them, and
+                # keeping both would inflate the count per retry
+                held = sem.suspend_task()
+                if restore_count is not None:
+                    held = min(held, restore_count)
+            if pending is not None:
+                self._checkpoint_pending(pending)
+            fw = self.spill_framework
+            if fw is None:
+                from .spill import SpillFramework
+
+                fw = SpillFramework._instance  # never create one here
+            if fw is not None:
+                target = fw.device_bytes // 2
+                spilled = fw.spill_device_to_target(target)
+                if spilled and self.spill_bytes is not None:
+                    self.spill_bytes.add(spilled)
+            time.sleep(backoff_delay_s(
+                attempt - 1, self.backoff_base_ms, self.backoff_max_ms,
+                self._rng))
+            if sem is not None:
+                sem.resume_task(held)
+        if self.block_time_ms is not None:
+            self.block_time_ms.add(
+                int((time.perf_counter() - start) * 1000))
+
+    # ------------------------------------------------------------------
+    def _checkpoint_pending(self, pending: deque) -> None:
+        """Register not-yet-attempted device batches with the spill
+        catalog (the combinators' input checkpoint): while this task
+        waits out the backoff, the spiller may evict them to host."""
+        fw = self.spill_framework
+        if fw is None:
+            return
+        from ..data.column import DeviceBatch
+        from .spill import SpillPriorities
+
+        for i, entry in enumerate(pending):
+            if isinstance(entry, DeviceBatch):
+                try:
+                    pending[i] = _Checkpointed(
+                        fw.add_batch(
+                            entry,
+                            priority=SpillPriorities.ACTIVE_ON_DECK),
+                        fw)
+                except MemoryError:
+                    # can't checkpoint under pressure: keep it raw
+                    pass
+
+
+class _Checkpointed:
+    """A pending input parked in the spill catalog during recovery."""
+
+    __slots__ = ("buf_id", "fw")
+
+    def __init__(self, buf_id: int, fw):
+        self.buf_id = buf_id
+        self.fw = fw
+
+    def restore(self):
+        with _shield():
+            db = self.fw.acquire_batch(self.buf_id)
+            self.fw.release_batch(self.buf_id)
+            self.fw.remove_batch(self.buf_id)
+        return db
+
+
+def _materialize(entry):
+    return entry.restore() if isinstance(entry, _Checkpointed) else entry
+
+
+# ==========================================================================
+# Combinators
+# ==========================================================================
+def _attempt(rctx: RetryContext, fn: Callable, item,
+             allow_split: bool, pending: Optional[deque] = None,
+             recovering: bool = False):
+    """Run ``fn(item)`` with the retry protocol.  TpuSplitAndRetryOOM
+    always propagates to the caller (who splits when it can); a plain
+    TpuRetryOOM recovers and retries up to ``max_retries`` times, then
+    escalates to a split request (when allowed) or surfaces.
+    ``recovering=True`` marks even the first call as recovery work
+    (pieces downstream of a split) so mode=random injection stays
+    suppressed and split recovery always converges."""
+    attempt = 0
+    base_count = rctx.held_count()  # semaphore hold BEFORE any attempt
+    while True:
+        try:
+            if attempt == 0 and not recovering:
+                return fn(item)
+            with _recovering():
+                return fn(item)
+        except TpuRetryOOM as e:
+            if isinstance(e, TpuSplitAndRetryOOM) and allow_split:
+                raise  # the caller splits
+            # a split request where no split is possible (only the
+            # injector can deliver one here — real escalation happens
+            # above this frame) degrades to plain spill+backoff+retry
+            attempt += 1
+            if attempt > rctx.max_retries:
+                if allow_split:
+                    raise TpuSplitAndRetryOOM(
+                        f"{rctx.op_name}: {rctx.max_retries} retries "
+                        "exhausted without the allocation succeeding — "
+                        "escalating to split-and-retry",
+                        injected=e.injected) from e
+                raise
+            log.warning("%s: OOM (attempt %d/%d) — spilling and "
+                        "retrying: %s", rctx.op_name, attempt,
+                        rctx.max_retries, e)
+            rctx.recover(attempt, pending, restore_count=base_count)
+
+
+def retry_call(fn: Callable[[], object],
+               ctx: Optional[RetryContext] = None,
+               allow_split: bool = False):
+    """Single-call form: re-execute ``fn()`` through the retry protocol.
+    TpuSplitAndRetryOOM propagates — use :func:`with_split_retry` when
+    the input can be split, or pass ``allow_split=True`` when the CALLER
+    catches TpuSplitAndRetryOOM and splits itself: then a genuine OOM
+    that exhausts ``max_retries`` ESCALATES to a split request instead
+    of failing the task (without it, real memory pressure could never
+    reach a caller's split fallback — only injected split faults
+    would)."""
+    rctx = ctx if ctx is not None else RetryContext()
+    return _attempt(rctx, lambda _unused: fn(), None,
+                    allow_split=allow_split)
+
+
+def with_retry(batch_iter: Iterable, fn: Callable,
+               ctx: Optional[RetryContext] = None) -> Iterator:
+    """Apply ``fn`` to each batch of ``batch_iter`` with OOM retry.  The
+    current batch is the checkpoint: a retried attempt re-runs ``fn``
+    on the SAME batch (``fn`` must be effect-free until it returns).
+    TpuSplitAndRetryOOM propagates — the inputs of this form are not
+    splittable."""
+    rctx = ctx if ctx is not None else RetryContext()
+    for item in batch_iter:
+        yield _attempt(rctx, fn, item, allow_split=False)
+
+
+def can_split(batch, rctx: RetryContext) -> bool:
+    """True when ``batch`` is above the ``retry.minSplitRows`` floor —
+    callers with a split fallback should check this and degrade to
+    plain :func:`retry_call` when splitting is impossible."""
+    n = _num_rows(batch)
+    return n > rctx.min_split_rows and n > 1
+
+
+def _bottom_out(rctx: RetryContext, n: int,
+                cause: Optional[BaseException]):
+    """The genuine-OOM diagnostic raised when no further split is
+    possible (single source for the user-facing message)."""
+    raise TpuSplitAndRetryOOM(
+        f"{rctx.op_name}: split-and-retry bottomed out at {n} rows "
+        f"(spark.rapids.tpu.memory.retry.minSplitRows="
+        f"{rctx.min_split_rows}) — the device cannot fit even the "
+        "smallest split of this input; this is a genuine OOM"
+    ) from cause
+
+
+def split_or_raise(batch, rctx: RetryContext,
+                   split: Callable = halve_rows,
+                   cause: Optional[BaseException] = None) -> List:
+    """Split ``batch`` (counting the split in metrics), or raise a
+    diagnostic naming the operator once the ``retry.minSplitRows`` floor
+    is reached — at that point the OOM is genuine."""
+    n = _num_rows(batch)
+    if n <= rctx.min_split_rows or n <= 1:
+        _bottom_out(rctx, n, cause)
+    rctx.on_split()
+    with _shield():
+        return split(batch)
+
+
+def with_split_retry(batch, fn: Callable,
+                     split: Callable = halve_rows,
+                     ctx: Optional[RetryContext] = None,
+                     initial_split: bool = False) -> Iterator:
+    """Apply ``fn`` to ``batch`` with OOM retry, escalating to halving
+    the input by rows — recursively, down to the ``retry.minSplitRows``
+    floor — and yielding ``fn(piece)`` for each piece in row order.
+
+    The caller must only use this when per-piece results compose into
+    the unsplit result (row-local operators, or buffer-form aggregates
+    merged by the caller).  ``initial_split=True`` splits once before
+    the first attempt (used when the caller already observed a split
+    request for this batch)."""
+    rctx = ctx if ctx is not None else RetryContext()
+    work: deque = deque([batch])
+    degraded = initial_split  # a split happened: we are in recovery
+    if initial_split:
+        work = deque(split_or_raise(batch, rctx, split))
+    while work:
+        item = _materialize(work.popleft())
+        at_floor = False
+        base_hold = rctx.held_count()
+        try:
+            # once degraded, pieces run as recovery work so mode=random
+            # injection cannot re-fire on them and drive the recursion
+            # to the minSplitRows floor (a spurious "genuine OOM")
+            yield _attempt(rctx, fn, item, allow_split=True,
+                           pending=work, recovering=degraded)
+            continue
+        except TpuSplitAndRetryOOM as e:
+            # drop semaphore acquires the failed attempt made — the
+            # pieces' attempts re-acquire for themselves
+            rctx.rewind_hold(base_hold)
+            n = _num_rows(item)
+            at_floor = n <= rctx.min_split_rows or n <= 1
+            if not at_floor:
+                pieces = split_or_raise(item, rctx, split, cause=e)
+                degraded = True
+                work.extendleft(reversed(pieces))
+                continue
+        # at the minSplitRows floor no further split is possible: give
+        # the piece one full round of plain spill+backoff retries (with
+        # injection suppressed as recovery) before declaring the OOM
+        # genuine — without this, an injected split request against an
+        # already-small batch would bottom out spuriously
+        try:
+            yield _attempt(rctx, fn, item, allow_split=False,
+                           pending=work, recovering=True)
+        except TpuRetryOOM as e2:
+            _bottom_out(rctx, _num_rows(item), e2)
+
+
+# ==========================================================================
+# Degraded-query visibility
+# ==========================================================================
+def retry_summary(metric_snapshot) -> str:
+    """One-line summary of the retry counters in a metrics snapshot
+    (``Session.last_metrics``); empty string when the query saw no
+    memory pressure."""
+    keys = ("retry.numRetries", "retry.numSplitRetries",
+            "retry.retryBlockTimeMs", "retry.spillBytesOnRetry")
+    vals = {k: metric_snapshot.get(k, 0) for k in keys}
+    if not any(vals.values()):
+        return ""
+    return ("numRetries=%d numSplitRetries=%d retryBlockTimeMs=%d "
+            "spillBytesOnRetry=%d" % tuple(vals[k] for k in keys))

@@ -86,11 +86,14 @@ class DeviceSemaphore:
                 self._last_release = time.monotonic()
                 self._sem.release()
 
-    def release_all(self) -> None:
-        """Drop this thread's entire hold — the task-completion release
-        (reference: GpuSemaphore's task-completion listener,
-        GpuSemaphore.scala:101-160).  The underlying permit is held once
-        per thread regardless of the reentrancy count."""
+    def release_task(self) -> None:
+        """Release ONLY the calling task's permits — its thread-local
+        hold, whatever the reentrancy count (reference: GpuSemaphore's
+        task-completion listener releases the completing task's hold,
+        GpuSemaphore.scala:101-160).  This is the failure-path release:
+        a task that dies or enters OOM recovery drops ITS permits and
+        nothing else, so concurrently-running healthy tasks are never
+        stranded by a peer's cleanup."""
         import time
 
         count = getattr(self._held, "count", 0)
@@ -98,6 +101,48 @@ class DeviceSemaphore:
             self._held.count = 0
             self._last_release = time.monotonic()
             self._sem.release()
+
+    def release_all(self) -> None:
+        """Deprecated name for :meth:`release_task` — it never released
+        other tasks' permits (the hold is thread-local), but the name
+        suggested it did; call sites on failure paths should use
+        ``release_task`` so the per-task scope is explicit."""
+        self.release_task()
+
+    def held_count(self) -> int:
+        """This task's current reentrancy count (0 = no permit held)."""
+        return getattr(self._held, "count", 0)
+
+    def suspend_task(self) -> int:
+        """Drop this task's permit for a blocking wait and return the
+        reentrancy count so :meth:`resume_task` can restore it exactly.
+        The count pairs with per-batch acquire/release protocols (H2D
+        acquires once per uploaded batch, D2H unwinds one per output
+        batch) — collapsing it to 1 across a wait would make a later
+        single release drop the permit while device work is still in
+        flight."""
+        count = getattr(self._held, "count", 0)
+        self.release_task()
+        return count
+
+    def resume_task(self, count: int) -> None:
+        """Re-enter device admission after :meth:`suspend_task`,
+        restoring the saved reentrancy count (no-op for count 0: a task
+        that held nothing must not gain a hold it never had)."""
+        if count > 0:
+            self.acquire_if_necessary()
+            self._held.count = count
+
+    def rewind_task(self, count: int) -> None:
+        """Drop this task's reentrancy count DOWN to ``count``,
+        releasing the permit when it reaches 0 — undoes acquires made
+        by a failed attempt so its re-execution (which re-acquires)
+        doesn't inflate the count."""
+        if self.held_count() > count:
+            if count <= 0:
+                self.release_task()
+            else:
+                self._held.count = count
 
     def __enter__(self):
         self.acquire_if_necessary()

@@ -278,31 +278,55 @@ class SpillFramework:
             self.catalog.register(buf)
             self.device_queue.push(buf.id, buf.priority)
             self.device_bytes += buf.size
-            self._track_device(buf.size)
+            try:
+                self._track_device(buf.size)
+            except MemoryError:
+                # TpuRetryOOM (real or injected): roll back so the
+                # retry framework can re-register after recovery
+                self.device_queue.remove(buf.id)
+                self.device_bytes -= buf.size
+                self.catalog.remove(buf.id)
+                raise
             if self.device_limit is not None \
                     and self.device_bytes > self.device_limit:
                 self.spill_device_to_target(self.device_limit)
             return buf.id
 
     def acquire_batch(self, buf_id: int) -> DeviceBatch:
-        """Pin + materialize on device (promotes spilled buffers)."""
+        """Pin + materialize on device (promotes spilled buffers).
+
+        A promotion is an allocation: tracking runs BEFORE the re-upload
+        so an OOM (real or injected) leaves the buffer untouched on its
+        current tier, unpinned, for the retry framework to re-acquire
+        after recovery."""
         buf = self.catalog.acquire(buf_id)
-        with self._lock:
-            prev_tier = buf.tier
-            db = buf.get_device_batch()
-            if prev_tier != StorageTier.DEVICE:
-                if prev_tier == StorageTier.HOST:
-                    self.host_bytes -= buf.size
-                    self.host_queue.remove(buf.id)
-                self.device_bytes += buf.size
-                self.device_queue.push(buf.id, buf.priority)
-                self._track_device(buf.size)
-                # promotion is an allocation too: enforce the device limit
-                # (the promoted buffer itself is pinned, so it is skipped)
-                if self.device_limit is not None \
-                        and self.device_bytes > self.device_limit:
-                    self.spill_device_to_target(self.device_limit)
-            return db
+        try:
+            with self._lock:
+                prev_tier = buf.tier
+                if prev_tier != StorageTier.DEVICE:
+                    self._track_device(buf.size)
+                    try:
+                        db = buf.get_device_batch()
+                    except BaseException:
+                        self._track_device(-buf.size)
+                        raise
+                    if prev_tier == StorageTier.HOST:
+                        self.host_bytes -= buf.size
+                        self.host_queue.remove(buf.id)
+                    self.device_bytes += buf.size
+                    self.device_queue.push(buf.id, buf.priority)
+                    # promotion is an allocation too: enforce the device
+                    # limit (the promoted buffer itself is pinned, so it
+                    # is skipped)
+                    if self.device_limit is not None \
+                            and self.device_bytes > self.device_limit:
+                        self.spill_device_to_target(self.device_limit)
+                else:
+                    db = buf.get_device_batch()
+                return db
+        except BaseException:
+            self.catalog.release(buf_id)
+            raise
 
     def release_batch(self, buf_id: int) -> None:
         self.catalog.release(buf_id)

@@ -52,6 +52,15 @@ class ExecContext:
         #: shuffle ids registered during this query, freed at query end
         #: (reference: per-shuffle cleanup, ShuffleBufferCatalog.scala)
         self.shuffle_ids: List[int] = []
+        # (re)arm the OOM fault injector from this query's conf — per
+        # query so an oomInjection.skipCount sweep restarts its
+        # checkpoint counter every run (device sessions only; a host
+        # oracle session must not disarm a device session's injector)
+        if session is not None and \
+                getattr(session, "device_manager", None) is not None:
+            from ..memory.retry import OomInjector, install_injector
+
+            install_injector(OomInjector.from_conf(conf))
 
 
 class PartitionedData:
@@ -83,14 +92,23 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
     threads = 1
     retries = 0
     sem = None
+    backoff_base = backoff_max = None
+    backoff_rng = None
     if ctx is not None:
-        from ..config import TASK_RETRIES, TASK_THREADS
+        from ..config import (RETRY_BACKOFF_BASE_MS, RETRY_BACKOFF_MAX_MS,
+                              RETRY_BACKOFF_SEED, TASK_RETRIES,
+                              TASK_THREADS)
 
         retries = max(0, ctx.conf.get(TASK_RETRIES))
         if n > 1:
             threads = min(ctx.conf.get(TASK_THREADS), n)
         if ctx.session is not None and ctx.session.device_manager:
             sem = ctx.session.device_manager.semaphore
+        backoff_base = ctx.conf.get(RETRY_BACKOFF_BASE_MS)
+        backoff_max = ctx.conf.get(RETRY_BACKOFF_MAX_MS)
+        import random as _random
+
+        backoff_rng = _random.Random(ctx.conf.get(RETRY_BACKOFF_SEED))
 
     def drain_with_retry(pid: int):
         """One 'task': drain a partition, retrying on failure
@@ -100,25 +118,42 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
         re-executes the partition's lineage; the shuffle client's
         FetchRetry plays the same role, RapidsShuffleClient.scala:378).
         AssertionError is deterministic (strict-test-mode fallbacks,
-        invariant checks) and is never retried.  Known divergence:
-        batches emitted before the failure already counted in operator
-        metrics, so a retried partition inflates NUM_OUTPUT_* — the
-        same eager-accumulator behavior query metrics have under any
-        partially-consumed iterator."""
+        invariant checks) and is never retried, and neither is anything
+        derived from KeyboardInterrupt/SystemExit (the user/interpreter
+        asked to stop — re-executing the lineage would fight them).
+        Retries back off with bounded exponential delay + seeded jitter
+        (memory/retry.py) instead of hammering a contended device.
+        Known divergence: batches emitted before the failure already
+        counted in operator metrics, so a retried partition inflates
+        NUM_OUTPUT_* — the same eager-accumulator behavior query
+        metrics have under any partially-consumed iterator."""
+        import time as _time
+
+        from ..memory.retry import backoff_delay_s
+
         for attempt in range(retries + 1):
             try:
                 return list(data.iterator(pid))
+            except (KeyboardInterrupt, SystemExit):
+                raise
             except AssertionError:
                 raise
             except Exception:
                 if sem is not None:
-                    sem.release_all()  # drop a failed task's permits
+                    # drop ONLY this task's permits — a blanket release
+                    # would strand concurrently-running healthy tasks
+                    sem.release_task()
                 if attempt == retries:
                     raise
+                # backoff_base/max are always set here: retries > 0
+                # implies ctx is not None, which populated them
+                delay = backoff_delay_s(attempt, backoff_base,
+                                        backoff_max, backoff_rng)
                 log.warning("task for partition %d failed "
-                            "(attempt %d/%d) — retrying",
-                            pid, attempt + 1, retries + 1,
+                            "(attempt %d/%d) — retrying in %.1fms",
+                            pid, attempt + 1, retries + 1, delay * 1e3,
                             exc_info=True)
+                _time.sleep(delay)
         raise AssertionError("retry loop must return or raise")
 
     if threads <= 1:
@@ -133,7 +168,7 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
                 return drain_with_retry(pid)
             finally:
                 if sem is not None:
-                    sem.release_all()
+                    sem.release_task()
 
         with ThreadPoolExecutor(max_workers=threads) as pool:
             per_pid = list(pool.map(run_task, range(n)))

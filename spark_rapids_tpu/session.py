@@ -12,9 +12,12 @@ executor-plugin init path), and drives query execution:
 """
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from . import types as T
 from .config import EXPORT_COLUMNAR_RDD, TpuConf
@@ -50,6 +53,10 @@ class Session:
         self.capture_plans = False
         self.last_metrics: Dict[str, int] = {}
         self.last_write_stats = None  # WriteStatsTracker of last write
+        #: one-line retry/split-retry summary of the last execution
+        #: ("" when the query saw no memory pressure) — EXPLAIN/trace
+        #: surface for degraded queries
+        self.last_retry_summary: str = ""
         # logical-plan -> physical-plan cache: repeated collect() of the
         # same DataFrame reuses the exec instances and with them every
         # per-exec jit cache (without this, each collect re-traced and
@@ -195,6 +202,20 @@ class Session:
             # benchmark/debug hook: per-exec metric snapshot of the most
             # recent execution (upload/readback wall decomposition)
             self.last_metrics = ctx.metrics.snapshot()
+            # a degraded query must be VISIBLY degraded: surface the
+            # OOM retry/split counters next to the plan (trace log +
+            # last_retry_summary, mirroring the reference's retry
+            # metrics in the SQL UI)
+            from .memory.retry import retry_summary
+
+            self.last_retry_summary = retry_summary(self.last_metrics)
+            if self.last_retry_summary:
+                from .config import TRACE_ENABLED
+
+                lvl = logging.WARNING if self.conf.get(TRACE_ENABLED) \
+                    else logging.INFO
+                log.log(lvl, "query completed DEGRADED under memory "
+                        "pressure: %s", self.last_retry_summary)
             phys._exec_lock.release()
             # per-shuffle cleanup at query end — frees shuffle output
             # even when a reader abandoned early (limit over a join)

@@ -42,6 +42,12 @@ NUM_INPUT_BATCHES = "numInputBatches"
 TOTAL_TIME = "totalTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
 
+# OOM retry framework (memory/retry.py; registered as "retry.<name>")
+NUM_RETRIES = "numRetries"
+NUM_SPLIT_RETRIES = "numSplitRetries"
+RETRY_BLOCK_TIME = "retryBlockTimeMs"
+SPILL_BYTES_ON_RETRY = "spillBytesOnRetry"
+
 
 class MetricsRegistry:
     def __init__(self):

@@ -45,11 +45,33 @@ DeviceSemaphore.ACQUIRE_TIMEOUT_SECONDS = 60.0
 _PER_TEST_TIMEOUT = float(os.environ.get("SRT_TEST_TIMEOUT", "600"))
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+        "budget (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "oom_injection: drives operators through their "
+        "OOM-recovery paths via the deterministic fault injector "
+        "(spark.rapids.tpu.memory.oomInjection.*)")
+
+
 @pytest.fixture(autouse=True)
 def _hang_watchdog():
     faulthandler.dump_traceback_later(_PER_TEST_TIMEOUT, exit=True)
     yield
     faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture(autouse=True)
+def _disarm_oom_injector():
+    """An armed fault injector must never outlive its test — a later
+    test's ExecContext normally re-installs from its own conf, but a
+    test that fails before executing a query would otherwise inherit
+    injected OOMs."""
+    yield
+    from spark_rapids_tpu.memory.retry import install_injector
+
+    install_injector(None)
 
 
 @pytest.fixture()

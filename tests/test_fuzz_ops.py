@@ -83,3 +83,52 @@ def test_fuzz_string_and_window_ops(seed):
     exp = build(srt.Session(tpu_enabled=False)).collect()
     assert_rows_equal(exp, got, ignore_order=True,
                       approximate_float=1e-9)
+
+
+@pytest.mark.oom_injection
+@pytest.mark.parametrize("seed", [7, 29])
+def test_fuzz_random_pipeline_under_random_oom_injection(seed):
+    """Seeded fuzz: a random expression pipeline (arithmetic /
+    conditional / string ops + group-by + sort) executed while the
+    fault injector randomly fails allocation checkpoints — recovery
+    must be invisible in the results (memory/retry.py)."""
+    rng = random.Random(seed)
+    nprng = np.random.RandomState(seed)
+    n = rng.choice([96, 200])
+    data = {
+        "k": [int(x) for x in nprng.randint(0, 6, n)],
+        "a": [None if nprng.rand() < 0.1 else float(x)
+              for x in (nprng.rand(n) * 50).round(3)],
+        "b": [int(x) for x in nprng.randint(-20, 20, n)],
+        "s": _rand_strings(rng, n, "abc.-", 9),
+    }
+    c1 = rng.choice(["a", "b"])
+    c2 = rng.choice(["a", "b"])
+    thresh = float(rng.randrange(-10, 10))
+    pat = _rand_pattern(rng)
+
+    def build(sess):
+        df = sess.create_dataframe(dict(data))
+        q = df.select(
+            "k", "s",
+            (df[c1] + df[c2]).alias("add"),
+            (df["a"] * 2.0 - df["b"]).alias("mix"),
+            f.when(df["b"] > thresh, df["a"]).otherwise(
+                f.lit(0.0)).alias("cond"),
+            df["s"].like(pat).alias("lk"))
+        q = q.group_by("k").agg(
+            f.sum("add").alias("sa"),
+            f.min("mix").alias("mm"),
+            f.count("*").alias("c"))
+        return q.sort(f.col("k"))
+
+    inject = {
+        "spark.rapids.tpu.memory.oomInjection.mode": "random",
+        "spark.rapids.tpu.memory.oomInjection.seed": seed,
+        "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+        "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+    }
+    got = build(srt.Session(inject)).collect()
+    exp = build(srt.Session(tpu_enabled=False)).collect()
+    assert_rows_equal(exp, got, ignore_order=True,
+                      approximate_float=1e-9)

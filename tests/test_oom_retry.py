@@ -1,0 +1,561 @@
+"""OOM retry & split-and-retry framework (memory/retry.py).
+
+Reference analogue: the successor lineage's RmmRapidsRetryIterator
+suites + the RMM OOM-injection test mode.  The central invariant:
+with the deterministic fault injector driving OOMs through every
+allocation checkpoint (``oomInjection.mode=nth``, skipCount sweeping),
+every wired operator path — upload, join, aggregate, sort, exchange —
+must produce results identical to an injection-free run, with the
+degradation visible in the retry metrics.
+"""
+import random
+
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import f
+from spark_rapids_tpu.memory.retry import (OomInjector, RetryContext,
+                                           TpuRetryOOM,
+                                           TpuSplitAndRetryOOM,
+                                           backoff_delay_s, halve_rows,
+                                           retry_call, with_retry,
+                                           with_split_retry)
+from spark_rapids_tpu.testing.asserts import assert_rows_equal
+
+#: fast-recovery confs shared by every injection test (the backoff is
+#: real code either way; CI just must not sleep through its budget)
+FAST = {
+    "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+    "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+}
+
+
+def _norm(rows):
+    return sorted(
+        (tuple((None if v is None else
+                (round(v, 9) if isinstance(v, float) else v))
+               for v in r) for r in rows),
+        key=repr)
+
+
+def _inject(mode, skip=0, seed=0, oom_type="retry", **extra):
+    conf = dict(FAST)
+    conf.update({
+        "spark.rapids.tpu.memory.oomInjection.mode": mode,
+        "spark.rapids.tpu.memory.oomInjection.skipCount": skip,
+        "spark.rapids.tpu.memory.oomInjection.seed": seed,
+        "spark.rapids.tpu.memory.oomInjection.oomType": oom_type,
+    })
+    conf.update(extra)
+    return conf
+
+
+# ==========================================================================
+# combinator unit tests (no engine)
+# ==========================================================================
+def test_retry_call_recovers_and_counts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TpuRetryOOM("synthetic pressure")
+        return 42
+
+    rctx = RetryContext(op_name="unit", conf=srt.TpuConf(FAST))
+    assert retry_call(flaky, rctx) == 42
+    assert len(calls) == 3
+
+
+def test_retry_call_exhausts_and_surfaces():
+    rctx = RetryContext(op_name="unit", conf=srt.TpuConf(dict(
+        FAST, **{"spark.rapids.tpu.memory.retry.maxRetries": 2})))
+
+    def always_oom():
+        raise TpuRetryOOM("synthetic pressure")
+
+    with pytest.raises(TpuRetryOOM):
+        retry_call(always_oom, rctx)
+
+
+def test_retry_call_escalates_to_split_when_allowed():
+    """A genuine OOM that exhausts its retries must reach a caller's
+    split fallback (allow_split=True), not fail the task."""
+    rctx = RetryContext(op_name="unit", conf=srt.TpuConf(dict(
+        FAST, **{"spark.rapids.tpu.memory.retry.maxRetries": 2})))
+
+    def always_oom():
+        raise TpuRetryOOM("synthetic pressure")
+
+    with pytest.raises(TpuSplitAndRetryOOM):
+        retry_call(always_oom, rctx, allow_split=True)
+
+
+def test_recover_restores_reentrant_semaphore_count():
+    """recover() must suspend and RESTORE the task's reentrancy count:
+    per-batch acquire/release protocols (H2D/D2H) depend on it, and a
+    collapse to 1 would release the permit mid-pipeline."""
+    from spark_rapids_tpu.memory.semaphore import DeviceSemaphore
+
+    sem = DeviceSemaphore(2)
+    for _ in range(3):
+        sem.acquire_if_necessary()  # reentrant hold, count=3
+    rctx = RetryContext(op_name="unit", conf=srt.TpuConf(FAST),
+                        semaphore=sem)
+    rctx.recover(1)
+    assert sem._held.count == 3
+    for _ in range(2):
+        sem.release_if_necessary()
+    assert sem._held.count == 1, "count must unwind per-release"
+    sem.release_task()
+
+
+def test_failed_attempt_does_not_inflate_semaphore_hold():
+    """An fn that acquires the semaphore inside the retried attempt
+    (the upload path) must not leave an extra hold per failed attempt —
+    the reentrancy count after recovery must equal one successful
+    attempt's worth."""
+    from spark_rapids_tpu.memory.semaphore import DeviceSemaphore
+
+    sem = DeviceSemaphore(2)
+    rctx = RetryContext(op_name="unit", conf=srt.TpuConf(FAST),
+                        semaphore=sem)
+    state = {"fails": 2}
+
+    def fn():
+        sem.acquire_if_necessary()
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise TpuRetryOOM("pressure")
+        return 1
+
+    assert retry_call(fn, rctx) == 1
+    assert sem.held_count() == 1, \
+        "failed attempts must not stack semaphore holds"
+    sem.release_task()
+
+
+def test_split_propagation_does_not_inflate_semaphore_hold():
+    from spark_rapids_tpu.data.column import HostBatch
+    from spark_rapids_tpu.memory.semaphore import DeviceSemaphore
+
+    sem = DeviceSemaphore(2)
+    rctx = RetryContext(op_name="unit", conf=srt.TpuConf(FAST),
+                        semaphore=sem)
+    batch = HostBatch.from_pydict({"x": list(range(4))})
+    armed = {"v": True}
+
+    def fn(hb):
+        sem.acquire_if_necessary()
+        if armed["v"] and hb.num_rows > 2:
+            armed["v"] = False
+            raise TpuSplitAndRetryOOM("too big")
+        return hb.num_rows
+
+    assert list(with_split_retry(batch, fn, ctx=rctx)) == [2, 2]
+    # one hold per SUCCESSFUL piece attempt; the failed whole-batch
+    # attempt's acquire was rewound before the pieces ran
+    assert sem.held_count() == 2
+    sem.release_task()
+
+
+def test_random_injection_suppressed_after_split():
+    """Once a batch has split, mode=random must not re-fire on the
+    pieces — otherwise small batches recurse to the minSplitRows floor
+    and surface a spurious 'genuine OOM'."""
+    from spark_rapids_tpu.data.column import HostBatch
+    from spark_rapids_tpu.memory.retry import install_injector
+
+    batch = HostBatch.from_pydict({"x": list(range(8))})
+    inj = OomInjector(mode="random", seed=0, oom_type="split")
+    inj.RANDOM_PROBABILITY = 1.0  # would always fire if not suppressed
+    install_injector(inj)
+
+    def fn(hb):
+        from spark_rapids_tpu.memory.retry import maybe_inject_oom
+
+        maybe_inject_oom("unit")
+        return hb.num_rows
+
+    try:
+        rctx = RetryContext(op_name="unit", conf=srt.TpuConf(FAST))
+        pieces = list(with_split_retry(batch, fn, ctx=rctx))
+        assert sum(pieces) == 8 and len(pieces) == 2, pieces
+        assert inj.injections_fired == 1
+    finally:
+        install_injector(None)
+
+
+def test_with_retry_iterates_each_batch():
+    rctx = RetryContext(op_name="unit", conf=srt.TpuConf(FAST))
+    seen = {"oom": False}
+
+    def fn(x):
+        if x == 2 and not seen["oom"]:
+            seen["oom"] = True
+            raise TpuRetryOOM("once")
+        return x * 10
+
+    assert list(with_retry([1, 2, 3], fn, ctx=rctx)) == [10, 20, 30]
+    assert seen["oom"]
+
+
+def test_with_split_retry_halves_host_batch_in_row_order():
+    from spark_rapids_tpu.data.column import HostBatch
+
+    batch = HostBatch.from_pydict({"x": list(range(8))})
+    big = {"flag": True}
+
+    def fn(hb):
+        if hb.num_rows > 2 and big["flag"]:
+            raise TpuSplitAndRetryOOM("too big")
+        return [hb.column(0)[i] for i in range(hb.num_rows)]
+
+    rctx = RetryContext(op_name="unit", conf=srt.TpuConf(FAST))
+    pieces = list(with_split_retry(batch, fn, ctx=rctx))
+    # recursive halving: 8 -> 4+4 -> 2+2+2+2, row order preserved
+    assert [v for p in pieces for v in p] == list(range(8))
+    assert all(len(p) <= 2 for p in pieces)
+
+
+def test_split_bottoms_out_with_operator_diagnostic():
+    from spark_rapids_tpu.data.column import HostBatch
+
+    batch = HostBatch.from_pydict({"x": list(range(64))})
+    rctx = RetryContext(op_name="UnitOpExec", conf=srt.TpuConf(dict(
+        FAST, **{"spark.rapids.tpu.memory.retry.minSplitRows": 16})))
+
+    def always(hb):
+        raise TpuSplitAndRetryOOM("pressure")
+
+    with pytest.raises(TpuSplitAndRetryOOM) as ei:
+        list(with_split_retry(batch, always, ctx=rctx))
+    msg = str(ei.value)
+    assert "UnitOpExec" in msg and "minSplitRows=16" in msg, msg
+
+
+def test_backoff_bounded_exponential_with_jitter():
+    rng = random.Random(5)
+    delays = [backoff_delay_s(a, base_ms=2.0, max_ms=50.0, rng=rng)
+              for a in range(10)]
+    # jittered within [0.5, 1.0) x cap, never above the bound
+    for a, d in enumerate(delays):
+        cap = min(2.0 * 2 ** a, 50.0) / 1000.0
+        assert cap * 0.5 <= d <= cap, (a, d, cap)
+    # deterministic given the seed
+    rng2 = random.Random(5)
+    assert delays == [backoff_delay_s(a, 2.0, 50.0, rng2)
+                      for a in range(10)]
+
+
+def test_injector_nth_is_one_shot_and_counted():
+    inj = OomInjector(mode="nth", skip_count=2)
+    inj.check("a")
+    inj.check("b")
+    with pytest.raises(TpuRetryOOM) as ei:
+        inj.check("c")
+    assert ei.value.injected
+    for _ in range(20):
+        inj.check("d")  # disarmed
+    assert inj.injections_fired == 1
+
+
+def test_injector_halve_rows_device_batch():
+    from spark_rapids_tpu.data.column import HostBatch, host_to_device
+
+    db = host_to_device(HostBatch.from_pydict(
+        {"x": list(range(10)), "s": [str(i) for i in range(10)]}))
+    a, b = halve_rows(db)
+    assert int(a.num_rows) == 5 and int(b.num_rows) == 5
+    from spark_rapids_tpu.data.column import device_to_host
+
+    ha, hb = device_to_host(a), device_to_host(b)
+    assert [ha.column(0)[i] for i in range(5)] == [0, 1, 2, 3, 4]
+    assert [hb.column(1)[i] for i in range(5)] == ["5", "6", "7", "8",
+                                                   "9"]
+
+
+# ==========================================================================
+# oracle-equality under injection (the acceptance invariant)
+# ==========================================================================
+def _dual_run(build, conf):
+    got_sess = srt.Session(conf)
+    got = build(got_sess).collect()
+    exp = build(srt.Session(tpu_enabled=False)).collect()
+    return _norm(exp), _norm(got), got_sess.last_metrics
+
+
+@pytest.mark.oom_injection
+@pytest.mark.parametrize("skip", [0, 1, 2, 3, 5, 8, 13])
+def test_nth_injection_sweep_tpch_q1_style(skip):
+    """A TPC-H Q1-style pipeline (filter + projected arithmetic +
+    group-by aggregates + sort) survives an OOM at any allocation
+    checkpoint with bit-identical results."""
+    n = 96
+
+    def build(sess):
+        df = sess.create_dataframe({
+            "flag": [["A", "N", "R"][i % 3] for i in range(n)],
+            "qty": [float(i % 17) for i in range(n)],
+            "price": [100.0 + i for i in range(n)],
+            "disc": [(i % 5) / 100.0 for i in range(n)],
+        })
+        df = df.filter(df["qty"] < 15.0)
+        df = df.select(
+            "flag", "qty",
+            (df["price"] * (1.0 - df["disc"])).alias("net"))
+        return df.group_by("flag").agg(
+            f.sum("qty").alias("sum_qty"),
+            f.sum("net").alias("sum_net"),
+            f.avg("qty").alias("avg_qty"),
+            f.count("*").alias("cnt"),
+        ).sort(f.col("flag"))
+
+    exp, got, metrics = _dual_run(build, _inject("nth", skip=skip))
+    assert_rows_equal(exp, got, approximate_float=1e-9)
+
+
+@pytest.mark.oom_injection
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "semi", "anti"])
+def test_nth_injection_join_oracle_equality(how):
+    left = {"k": [1, 2, 2, 3, None, 5, 6] * 4,
+            "a": [float(i) for i in range(28)]}
+    right = {"k": [2, 2, 3, 4, None, 6] * 4,
+             "b": ["x", "y", "z", "w", "n", "q"] * 4}
+
+    def build(sess):
+        l = sess.create_dataframe(left)
+        r = sess.create_dataframe(right)
+        return l.join(r, on="k", how=how)
+
+    fired = 0
+    for skip in range(0, 9, 2):
+        exp, got, metrics = _dual_run(
+            build,
+            _inject("nth", skip=skip, **{
+                "spark.rapids.tpu.sql.broadcastSizeThreshold": 0}))
+        assert exp == got, (how, skip)
+        fired += metrics.get("retry.numRetries", 0)
+    assert fired > 0, "sweep never hit a checkpoint — injector dead?"
+
+
+@pytest.mark.oom_injection
+@pytest.mark.parametrize("seed", [3, 19])
+def test_random_injection_agg_and_sort(seed):
+    n = 128
+
+    def build(sess):
+        df = sess.create_dataframe({
+            "k": [i % 7 for i in range(n)],
+            "v": [float((i * 13) % 101) for i in range(n)],
+        })
+        return df.group_by("k").agg(
+            f.sum("v").alias("s"), f.max("v").alias("m"),
+            f.count("*").alias("c")).sort(f.col("k"))
+
+    exp, got, metrics = _dual_run(build, _inject("random", seed=seed))
+    assert_rows_equal(exp, got, approximate_float=1e-9)
+    assert metrics.get("retry.numRetries", 0) > 0, \
+        "random mode with these seeds must exercise recovery"
+
+
+@pytest.mark.oom_injection
+@pytest.mark.parametrize("skip", [1, 4])
+def test_nth_injection_chunked_agg_out_of_core(skip):
+    """Multi-batch partitions drive the chunked concat+merge aggregate
+    (park/unpark through the spill catalog) — recovery must compose
+    per-piece buffer forms into the same answer."""
+    n = 128
+    small_batches = {"spark.rapids.tpu.sql.reader.batchSizeRows": 32}
+
+    def build(sess):
+        df = sess.create_dataframe({
+            "k": [i % 3 for i in range(n)],
+            "v": [float(i) for i in range(n)],
+        }, n_partitions=1)
+        return df.group_by("k").agg(
+            f.sum("v").alias("s"), f.min("v").alias("lo"),
+            f.count("*").alias("c")).sort(f.col("k"))
+
+    exp, got, metrics = _dual_run(
+        build, _inject("nth", skip=skip, **small_batches))
+    assert_rows_equal(exp, got, approximate_float=1e-9)
+
+
+@pytest.mark.oom_injection
+def test_split_and_retry_succeeds_and_is_visible():
+    """A split-type OOM on the upload path halves the batch, both
+    halves are processed, numSplitRetries lands in the metrics and the
+    degraded-query summary, and results still match the oracle."""
+    n = 64
+
+    def build(sess):
+        df = sess.create_dataframe({
+            "k": [i % 5 for i in range(n)],
+            "v": [float(i) for i in range(n)],
+        }, n_partitions=1)
+        return df.group_by("k").agg(f.sum("v").alias("s")) \
+            .sort(f.col("k"))
+
+    sess = srt.Session(_inject("nth", skip=0, oom_type="split"))
+    got = build(sess).collect()
+    exp = build(srt.Session(tpu_enabled=False)).collect()
+    assert_rows_equal(_norm(exp), _norm(got), approximate_float=1e-9)
+    assert sess.last_metrics.get("retry.numSplitRetries", 0) >= 1
+    assert "numSplitRetries=" in sess.last_retry_summary
+
+
+@pytest.mark.oom_injection
+def test_split_retry_bottoms_out_at_min_split_rows_in_query():
+    """mode=always keeps injecting split OOMs: the upload must halve
+    down to the minSplitRows floor and then surface a diagnostic that
+    names the operator — a genuine OOM, not an infinite loop."""
+    sess = srt.Session(_inject("always", oom_type="split", **{
+        "spark.rapids.tpu.memory.retry.minSplitRows": 16,
+        "spark.rapids.tpu.sql.taskRetries": 0,
+    }))
+    df = sess.create_dataframe(
+        {"x": [float(i) for i in range(64)]}, n_partitions=1)
+    with pytest.raises(TpuSplitAndRetryOOM) as ei:
+        df.select((df["x"] + 1.0).alias("y")).collect()
+    msg = str(ei.value)
+    assert "HostToDeviceExec" in msg and "minSplitRows=16" in msg, msg
+
+
+@pytest.mark.oom_injection
+def test_degraded_query_visible_in_trace_output(caplog):
+    """With sql.trace.enabled, a query that recovered from OOMs logs a
+    WARNING carrying the retry counters — a degraded query must be
+    visibly degraded (retry/split counters in EXPLAIN/trace output)."""
+    import logging
+
+    from spark_rapids_tpu.utils import tracing
+
+    sess = srt.Session(_inject("nth", skip=0, **{
+        "spark.rapids.tpu.sql.trace.enabled": True}))
+    try:
+        df = sess.create_dataframe({"x": [float(i) for i in range(32)]})
+        with caplog.at_level(logging.WARNING,
+                             logger="spark_rapids_tpu.session"):
+            df.select((df["x"] * 2.0).alias("y")).collect()
+    finally:
+        tracing.enable(False)  # session-enable is global
+    assert sess.last_metrics.get("retry.numRetries", 0) >= 1
+    assert "numRetries=" in sess.last_retry_summary
+    degraded = [r for r in caplog.records if "DEGRADED" in r.message]
+    assert degraded and "numRetries=" in degraded[0].getMessage()
+
+
+# ==========================================================================
+# arena exhaustion raises the typed OOM (not a bare error)
+# ==========================================================================
+def test_track_alloc_raises_typed_oom_when_unspillable():
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.memory.spill import (MemoryEventHandler,
+                                               SpillFramework)
+
+    dm = DeviceManager.get_or_create(TpuConf())
+    saved = (dm.arena_bytes, dm._allocated, dm.event_handler)
+    fw = SpillFramework()  # empty: nothing to spill
+    try:
+        dm.arena_bytes = 1024
+        dm._allocated = 0
+        dm.event_handler = MemoryEventHandler(fw, dm.arena_bytes)
+        with pytest.raises(TpuRetryOOM):
+            dm.track_alloc(4096)
+        # the failed allocation was rolled back for the retry
+        assert dm.allocated_bytes == 0
+    finally:
+        dm.arena_bytes, dm._allocated, dm.event_handler = saved
+
+
+# ==========================================================================
+# partition-task retry satellites (plan/physical.py)
+# ==========================================================================
+def test_drain_with_retry_does_not_retry_interrupts():
+    from spark_rapids_tpu.plan.physical import (ExecContext,
+                                                PartitionedData,
+                                                collect_batches)
+    from spark_rapids_tpu import types as T
+
+    calls = []
+
+    def part():
+        calls.append(1)
+        raise KeyboardInterrupt()
+        yield  # pragma: no cover
+
+    data = PartitionedData([part])
+    sess = srt.Session({"spark.rapids.tpu.sql.taskRetries": 3})
+    ctx = ExecContext(sess.conf, sess)
+    with pytest.raises(KeyboardInterrupt):
+        collect_batches(data, T.Schema([]), ctx)
+    assert len(calls) == 1, "interrupts must never re-execute lineage"
+
+
+def test_drain_with_retry_backs_off_and_recovers():
+    import time
+
+    from spark_rapids_tpu.plan.physical import (ExecContext,
+                                                PartitionedData,
+                                                collect_batches)
+    from spark_rapids_tpu.data.column import HostBatch
+
+    batch = HostBatch.from_pydict({"x": [1, 2, 3]})
+    state = {"fails": 2}
+
+    def part():
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise RuntimeError("transient")
+        yield batch
+
+    data = PartitionedData([part])
+    sess = srt.Session({
+        "spark.rapids.tpu.sql.taskRetries": 3,
+        "spark.rapids.tpu.memory.retry.backoffBaseMs": 20.0,
+        "spark.rapids.tpu.memory.retry.backoffMaxMs": 100.0,
+    })
+    ctx = ExecContext(sess.conf, sess)
+    t0 = time.monotonic()
+    out = collect_batches(data, batch.schema, ctx)
+    elapsed = time.monotonic() - t0
+    assert out.num_rows == 3
+    # two retries => two backoff sleeps of >= base/2 each
+    assert elapsed >= 0.02, f"no backoff observed ({elapsed:.4f}s)"
+
+
+def test_semaphore_release_task_only_touches_caller():
+    import threading
+
+    from spark_rapids_tpu.memory.semaphore import DeviceSemaphore
+
+    sem = DeviceSemaphore(2)
+    other_holds = threading.Event()
+    release_other = threading.Event()
+
+    def other_task():
+        sem.acquire_if_necessary()
+        other_holds.set()
+        release_other.wait(timeout=30)
+        sem.release_task()
+
+    t = threading.Thread(target=other_task, daemon=True)
+    t.start()
+    assert other_holds.wait(timeout=30)
+    sem.acquire_if_necessary()
+    sem.acquire_if_necessary()  # reentrant: still one permit
+    sem.release_task()  # drops ONLY this task's hold
+    # both permits must now be available to this thread even though the
+    # other task still holds its own — if release_task had touched the
+    # peer's permit the pool accounting would go negative and a later
+    # acquire would hang
+    sem.acquire_if_necessary()
+    sem.release_task()
+    release_other.set()
+    t.join(timeout=30)
+    # after the peer's own release, the full pool is free again
+    sem.acquire_if_necessary()
+    sem.release_task()

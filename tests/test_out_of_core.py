@@ -154,9 +154,9 @@ def test_grace_join_recurses_past_bucket_cap(monkeypatch):
     levels = []
     orig = TpuHashJoinExec._join_grace
 
-    def spy(self, l, r, total, target, level=0):
+    def spy(self, l, r, total, target, level=0, *args, **kwargs):
         levels.append(level)
-        return orig(self, l, r, total, target, level)
+        return orig(self, l, r, total, target, level, *args, **kwargs)
 
     monkeypatch.setattr(TpuHashJoinExec, "_join_grace", spy)
 
