@@ -132,7 +132,7 @@ def dump_markdown() -> str:
         if e.is_internal:
             continue
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
-    lines += ["", _MEMORY_ROBUSTNESS_DOC]
+    lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC]
     return "\n".join(lines)
 
 
@@ -166,6 +166,37 @@ is on.
 The `oomInjection.*` confs (table above) drive any operator path
 through its OOM-recovery path deterministically in CI on CPU-only JAX —
 no real memory exhaustion required."""
+
+
+_FAULT_TOLERANCE_DOC = """\
+## Distributed fault tolerance
+
+The `fault.*` confs (table above) configure the query-level
+fault-tolerance layer (`spark_rapids_tpu/fault/`, docs/fault_tolerance.md):
+
+* **Payload integrity** — spill frames and exchange host round-trips
+  carry CRC32C checksums computed on write and verified on read
+  (`fault.checksum.enabled`); a mismatch raises `TpuPayloadCorruption`
+  and the producing stage is recomputed from lineage.
+* **Stage watchdogs** — `fault.stageTimeoutMs` bounds every distributed
+  stage and leaf drain; a tripped watchdog abandons the hung attempt
+  with `TpuStageTimeout` and re-executes it, bounded by
+  `fault.maxStageRetries`.  `fault.semaphoreTimeoutMs` bounds a blocked
+  device-semaphore acquire, and `fault.queuePutTimeoutMs` bounds a
+  producer blocked on a full prefetch queue.
+* **Graceful degradation** — after `fault.maxStageRetries` the runner
+  falls back distributed -> single-process -> CPU-exec plan
+  (`fault.degrade.enabled`) instead of failing the query; the final
+  rung is reported as `fault.degradeLevel`.
+* **Deterministic injection** — `fault.injection.*` drives every
+  recovery path (`oom|corrupt|delay|stage_crash`, site-filtered,
+  `nth`/`random`/`always` modes) in CI on CPU-only JAX; every injected
+  run must produce results bit-identical to an injection-free run.
+
+Recovery is observable: `fault.numStageRetries`,
+`fault.numChecksumFailures`, `fault.numWatchdogTrips` and
+`fault.degradeLevel` land in `Session.last_metrics`, and a degraded
+query logs a DEGRADED summary."""
 
 
 # ==========================================================================
@@ -224,6 +255,81 @@ OOM_INJECTION_TYPE = conf("spark.rapids.tpu.memory.oomInjection.oomType").doc(
     "Type of injected OOM: retry (TpuRetryOOM — spill+backoff+retry) or "
     "split (TpuSplitAndRetryOOM — the input batch must be halved)"
 ).string_conf("retry")
+
+# --- distributed fault tolerance (fault/; reference: the transparent
+# recovery promise of SURVEY §L0 extended to the distributed path) ---------
+FAULT_INJECTION_MODE = conf("spark.rapids.tpu.fault.injection.mode").doc(
+    "Generalized fault-injection mode (fault/injector.py) driving every "
+    "recovery path deterministically in CI: none (off), nth (fire once "
+    "at matching checkpoint #skipCount), random (seeded, suppressed "
+    "during recovery), always (every matching checkpoint — proves "
+    "bounded retries exhaust into the degradation ladder)"
+).string_conf("none")
+FAULT_INJECTION_TYPE = conf("spark.rapids.tpu.fault.injection.type").doc(
+    "Injected fault type: oom (typed retry OOM), corrupt (flip a byte "
+    "in the next checksummed payload write so the read-side CRC32C "
+    "verify must catch it), delay (sleep delayMs at the checkpoint — a "
+    "straggler), stage_crash (raise TpuStageCrash — a died stage)"
+).string_conf("oom")
+FAULT_INJECTION_SKIP_COUNT = conf(
+    "spark.rapids.tpu.fault.injection.skipCount").doc(
+    "mode=nth: 0-based matching checkpoint at which the single "
+    "injected fault fires; sweeping 0..N drives every checkpoint of a "
+    "site class through recovery, one run at a time").int_conf(0)
+FAULT_INJECTION_SEED = conf("spark.rapids.tpu.fault.injection.seed").doc(
+    "Seed for mode=random's injection decisions").int_conf(0)
+FAULT_INJECTION_SITE = conf("spark.rapids.tpu.fault.injection.site").doc(
+    "Substring filter on checkpoint sites (spill.write, spill.read, "
+    "exchange.write, exchange.read, stage.run, leaf.drain, host.stack); "
+    "empty matches every site.  Only matching checkpoints advance the "
+    "skipCount counter").string_conf("")
+FAULT_INJECTION_DELAY_MS = conf(
+    "spark.rapids.tpu.fault.injection.delayMs").doc(
+    "type=delay: milliseconds the injected straggler sleeps at the "
+    "checkpoint").double_conf(50.0)
+FAULT_STAGE_TIMEOUT_MS = conf("spark.rapids.tpu.fault.stageTimeoutMs").doc(
+    "Stage watchdog: a distributed stage (or leaf drain) that has not "
+    "completed after this many milliseconds is abandoned with "
+    "TpuStageTimeout and re-executed from lineage (0 disables; leave "
+    "disabled on multi-controller deployments unless every controller "
+    "shares the conf — recovery control flow must stay replicated)"
+).int_conf(0)
+FAULT_MAX_STAGE_RETRIES = conf("spark.rapids.tpu.fault.maxStageRetries").doc(
+    "Bounded re-executions of a failed distributed stage/leaf before "
+    "the query walks down the degradation ladder (distributed -> "
+    "single-process -> CPU-exec plan)").int_conf(2)
+FAULT_CHECKSUM_ENABLED = conf("spark.rapids.tpu.fault.checksum.enabled").doc(
+    "Compute CRC32C checksums on spill-frame writes and exchange host "
+    "round-trips and verify them on read; a mismatch raises "
+    "TpuPayloadCorruption and triggers recompute-from-lineage of the "
+    "producing stage instead of consuming garbage").boolean_conf(True)
+FAULT_HOST_ROUNDTRIP_CHECKSUM = conf(
+    "spark.rapids.tpu.fault.checksum.hostRoundtrip").doc(
+    "Also stamp+verify the distributed runner's exchange host staging "
+    "(per-shard batches between drain and mesh placement).  Costs a "
+    "full CRC pass over the staged data per leaf, so it is off by "
+    "default in production; it arms automatically while a corrupt "
+    "fault injector is installed, and can be forced on to chase "
+    "suspected host-memory corruption").boolean_conf(False)
+FAULT_DEGRADE_ENABLED = conf("spark.rapids.tpu.fault.degrade.enabled").doc(
+    "Graceful degradation: a query that exhausts its fault recovery "
+    "(stage retries, task retries) re-executes on the next ladder rung "
+    "(single-process, then the CPU-exec plan) instead of failing; the "
+    "final rung is reported as fault.degradeLevel in "
+    "Session.last_metrics").boolean_conf(True)
+FAULT_SEMAPHORE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.fault.semaphoreTimeoutMs").doc(
+    "Device-semaphore acquire watchdog: a blocked acquire that sees no "
+    "progress for this long raises DeviceSemaphoreTimeout — a "
+    "retryable fault the degradation ladder can recover/degrade on — "
+    "instead of hanging the process (0 uses the built-in default of "
+    "180s)").int_conf(0)
+FAULT_QUEUE_PUT_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.fault.queuePutTimeoutMs").doc(
+    "Producer-side watchdog on bounded prefetch queues: a put() into a "
+    "persistently full queue past this deadline raises TpuStageTimeout "
+    "(the consumer has died or wedged) instead of busy-looping "
+    "silently (0 disables)").int_conf(180000)
 
 # --- scheduling -----------------------------------------------------------
 CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(

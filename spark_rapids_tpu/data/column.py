@@ -644,7 +644,15 @@ def device_to_host(batch: DeviceBatch, trim: bool = True) -> HostBatch:
 def _flatten_device_batch(b: DeviceBatch):
     import jax.numpy as jnp
 
-    leaves = [jnp.asarray(b.num_rows, dtype=jnp.int32)]
+    try:
+        num_rows = jnp.asarray(b.num_rows, dtype=jnp.int32)
+    except TypeError:
+        # structural re-flatten with sentinel leaves (jax builds dummy
+        # trees with object() leaves inside device_put/flatten_axes):
+        # flatten must stay PURELY structural there or every
+        # device_put of a DeviceBatch pytree explodes
+        num_rows = b.num_rows
+    leaves = [num_rows]
     spec = []
     for c in b.columns:
         if c.lengths is not None:

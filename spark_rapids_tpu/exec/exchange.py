@@ -30,6 +30,8 @@ from typing import List
 import numpy as np
 
 from ..data.column import DeviceBatch, DeviceColumn
+from ..fault import injector as F
+from ..fault.errors import TpuPayloadCorruption
 from ..memory import retry as R
 from ..ops.expression import as_device_column
 from ..ops.kernels import segment as seg
@@ -239,9 +241,12 @@ class TpuShuffleExchangeExec(TpuExec):
         def write_one(b):
             # registering a map-output batch is the write-side
             # allocation checkpoint; an OOM retries after spill+backoff
-            # (the batch itself is the checkpointed input)
+            # (the batch itself is the checkpointed input).  The fault
+            # checkpoint covers delay/crash injection; corruption is
+            # injected inside add_batch at the "exchange.write" site.
             R.maybe_inject_oom("TpuShuffleExchange.write")
-            return fw.add_batch(b)
+            F.maybe_inject_fault("exchange.write")
+            return fw.add_batch(b, site="exchange.write")
 
         def _drain_child():
             import jax
@@ -314,9 +319,14 @@ class TpuShuffleExchangeExec(TpuExec):
             except BaseException:
                 # a failed attempt must not leave its partial map
                 # output resident until query end — the re-armed retry
-                # registers a full fresh set
-                for bid in added:
-                    fw.remove_batch(bid)
+                # registers a full fresh set.  The catalog slots go
+                # with the buffers: a retried stage must not leak the
+                # dead attempt's ids in the shuffle index.
+                if catalog is not None:
+                    catalog.drop_buffers(shuffle_id, added)
+                else:
+                    for bid in added:
+                        fw.remove_batch(bid)
                 raise
             if is_range and samples:
                 import jax.numpy as jnp
@@ -406,6 +416,27 @@ class TpuShuffleExchangeExec(TpuExec):
             pid_cache[buf_id] = (id(b), pids)
             return pids
 
+        def recompute_from_lineage(cause):
+            """A corrupt map-output payload was detected on read: free
+            the whole attempt's buffers (slots included) and re-arm the
+            writer election, so the task-level retry re-executes the
+            shuffle write from lineage instead of consuming garbage
+            (the recompute contract of TpuPayloadCorruption)."""
+            with elect_lock:
+                old = store[0] if store else []
+                store.clear()
+                state["writer"] = False
+                state["error"] = cause
+                done.clear()
+            ids = [bid for bid, _rr in old]
+            for bid in ids:
+                pid_cache.pop(bid, None)
+            if catalog is not None:
+                catalog.drop_buffers(shuffle_id, ids)
+            else:
+                for bid in ids:
+                    fw.remove_batch(bid)
+
         def make(p):
             def it():
                 import jax
@@ -426,10 +457,30 @@ class TpuShuffleExchangeExec(TpuExec):
                     outs.clear()
 
                 for buf_id, rr_start in materialized():
+                    F.maybe_inject_fault("exchange.read")
                     # promotion of a spilled map-output batch is an
                     # allocation: route it through the retry framework
-                    b = R.retry_call(
-                        lambda bid=buf_id: fw.acquire_batch(bid), rctx)
+                    try:
+                        b = R.retry_call(
+                            lambda bid=buf_id: fw.acquire_batch(bid),
+                            rctx)
+                    except TpuPayloadCorruption as corrupt:
+                        recompute_from_lineage(corrupt)
+                        raise
+                    except KeyError as gone:
+                        # a peer reader already invalidated this
+                        # attempt (its corruption recovery freed the
+                        # buffers while we iterated the old id list):
+                        # surface a TYPED recoverable fault so task
+                        # retry / the ladder re-execute from lineage
+                        # instead of dying on a bare KeyError
+                        from ..fault.errors import TpuStageCrash
+
+                        raise TpuStageCrash(
+                            "shuffle map output invalidated by a "
+                            "peer's corruption recovery — re-reading "
+                            "from the re-executed write",
+                            site="exchange.read") from gone
                     try:
                         outs.append(self._slice_kernel(
                             b, pids_of(buf_id, b, rr_start),

@@ -9,9 +9,10 @@ GpuParquetScan.scala:554)."""
 from __future__ import annotations
 
 from ..data.column import bucket_rows, device_to_host, host_to_device
-from ..config import (BUCKET_MIN_ROWS, READER_BATCH_SIZE_BYTES,
-                      READER_BATCH_SIZE_ROWS, READER_PREFETCH_BATCHES,
-                      STRING_COLUMN_BYTES_GUARD)
+from ..config import (BUCKET_MIN_ROWS, FAULT_QUEUE_PUT_TIMEOUT_MS,
+                      READER_BATCH_SIZE_BYTES, READER_BATCH_SIZE_ROWS,
+                      READER_PREFETCH_BATCHES, STRING_COLUMN_BYTES_GUARD)
+from ..fault.errors import TpuPayloadCorruption, TpuStageTimeout
 from ..memory import retry as R
 from ..plan.physical import PartitionedData
 from ..utils import metrics as M
@@ -38,6 +39,60 @@ def _split_host_batch(batch, max_rows: int, max_bytes: int):
         return
     for start in range(0, n, rows_cap):
         yield batch.slice(start, min(start + rows_cap, n))
+
+
+def _bounded_put(q, item, stop, timeout_s: float) -> bool:
+    """Producer-side put into a bounded prefetch queue that (a) honors
+    the consumer's stop flag and (b) surfaces a watchdog error instead
+    of busy-looping silently when the queue stays full past
+    ``timeout_s`` (the consumer has died or wedged — satellite of the
+    r3 prefetch-deadlock family).  Returns False when stopped; raises
+    :class:`TpuStageTimeout` on deadline; True when delivered."""
+    import queue as _queue
+    import time as _time
+
+    deadline = (_time.monotonic() + timeout_s) if timeout_s > 0 else None
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except _queue.Full:
+            if deadline is not None and _time.monotonic() > deadline:
+                raise TpuStageTimeout(
+                    f"h2d prefetch queue stayed full for {timeout_s:.0f}s"
+                    " — the consumer stopped draining (died or wedged); "
+                    "abandoning the producer instead of spinning",
+                    site="h2d.prefetch")
+    return False
+
+
+def _next_prefetched(q, producer, err):
+    """Consumer-side bounded get: returns the next queue item, or
+    raises when the producer died without delivering its END sentinel
+    (``err`` is the producer's one-slot error box).  Never blocks
+    forever on a dead producer."""
+    import queue as _queue
+
+    while True:
+        try:
+            return q.get(timeout=1.0)
+        except _queue.Empty:
+            if err[0] is not None:
+                raise err[0]
+            if not producer.is_alive():
+                # the producer may have delivered its last item (or
+                # END) and exited between our get() expiry and the
+                # liveness check: drain once more before declaring it
+                # dead, or a healthy partition retries spuriously
+                try:
+                    return q.get_nowait()
+                except _queue.Empty:
+                    pass
+                if err[0] is not None:
+                    raise err[0]
+                raise TpuStageTimeout(
+                    "h2d prefetch producer died without delivering a "
+                    "result or error", site="h2d.prefetch")
 
 
 def _free_cached_uploads(fw, store):
@@ -80,6 +135,7 @@ class HostToDeviceExec(TpuExec):
         max_rows = ctx.conf.get(READER_BATCH_SIZE_ROWS)
         max_bytes = ctx.conf.get(READER_BATCH_SIZE_BYTES)
         prefetch = ctx.conf.get(READER_PREFETCH_BATCHES)
+        put_timeout_s = ctx.conf.get(FAULT_QUEUE_PUT_TIMEOUT_MS) / 1000.0
 
         fw = store = None
         from ..plan.physical import LocalScanExec
@@ -134,9 +190,20 @@ class HostToDeviceExec(TpuExec):
                             sem.acquire_if_necessary()
                         # promote if spilled (a promotion is an
                         # allocation: OOMs recover via spill+backoff)
-                        b = R.retry_call(
-                            lambda bid=buf_id: fw.acquire_batch(bid),
-                            rctx)
+                        try:
+                            b = R.retry_call(
+                                lambda bid=buf_id: fw.acquire_batch(bid),
+                                rctx)
+                        except TpuPayloadCorruption:
+                            # a cached upload rotted on a spill tier:
+                            # drop the partition's cache entries and let
+                            # the task-level retry re-upload from the
+                            # source (recompute-from-lineage)
+                            entries = store.pop(pid, [])
+                            held = None
+                            for bid, _n in entries:
+                                fw.remove_batch(bid)
+                            raise
                         if held is not None:
                             fw.release_batch(held)
                         held = buf_id
@@ -160,7 +227,8 @@ class HostToDeviceExec(TpuExec):
                 try:
                     for db in inner:
                         ids.append(R.retry_call(
-                            lambda d=db: fw.add_batch(d), rctx))
+                            lambda d=db: fw.add_batch(
+                                d, site="upload.cache"), rctx))
                         nrs.append(db.num_rows)
                         yield db
                     complete = True
@@ -197,26 +265,20 @@ class HostToDeviceExec(TpuExec):
                 q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
                 stop = threading.Event()
                 END = object()
-
-                def put(item) -> bool:
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            return True
-                        except queue.Full:
-                            continue
-                    return False
+                err = [None]  # producer's error box (queue-independent:
+                # a full queue must not swallow the failure)
 
                 def produce():
                     try:
                         for batch in child_data.iterator(pid):
                             for hb in _split_host_batch(
                                     batch, max_rows, max_bytes):
-                                if not put(hb):
+                                if not _bounded_put(q, hb, stop,
+                                                    put_timeout_s):
                                     return
-                        put(END)
+                        _bounded_put(q, END, stop, put_timeout_s)
                     except BaseException as e:  # noqa: BLE001
-                        put(e)
+                        err[0] = e
 
                 t = threading.Thread(
                     target=produce, daemon=True,
@@ -235,11 +297,9 @@ class HostToDeviceExec(TpuExec):
                             # shape of the r3 deadlocks
                             if sem:
                                 sem.release_all()
-                            item = q.get()
+                            item = _next_prefetched(q, t, err)
                         if item is END:
                             break
-                        if isinstance(item, BaseException):
-                            raise item
                         yield from upload_retry(item)
                 finally:
                     stop.set()

@@ -1,0 +1,32 @@
+"""Query-level fault tolerance.
+
+The distributed path must survive what PR-1's OOM framework survives
+locally: a corrupted spill/shuffle payload, a hung collective, a
+crashed stage.  This package holds the pieces:
+
+* :mod:`.errors`    — typed recoverable faults (corruption, crash,
+  watchdog timeout) under one :class:`~.errors.TpuFaultError` base
+* :mod:`.injector`  — the generalized deterministic
+  :class:`~.injector.FaultInjector` (``oom|corrupt|delay|stage_crash``)
+  every recovery path runs through in CI on CPU-only JAX
+* :mod:`.integrity` — CRC32C checksums over spill frames and exchange
+  host round-trips, verified on read
+* :mod:`.stats`     — the per-query ``fault.*`` counters surfaced in
+  ``Session.last_metrics``
+* :mod:`.ladder`    — the graceful-degradation ladder: distributed ->
+  single-process -> CPU-exec plan
+"""
+from .errors import (TpuFaultError, TpuPayloadCorruption, TpuStageCrash,
+                     TpuStageTimeout)
+from .injector import (FaultInjector, get_fault_injector,
+                       install_fault_injector, maybe_corrupt,
+                       maybe_inject_fault, recovery_in_flight)
+from .stats import GLOBAL as fault_stats
+from .stats import fault_summary
+
+__all__ = [
+    "TpuFaultError", "TpuPayloadCorruption", "TpuStageCrash",
+    "TpuStageTimeout", "FaultInjector", "get_fault_injector",
+    "install_fault_injector", "maybe_corrupt", "maybe_inject_fault",
+    "recovery_in_flight", "fault_stats", "fault_summary",
+]

@@ -1,0 +1,46 @@
+"""Typed fault exceptions of the query-level fault-tolerance layer.
+
+Reference analogue: the typed retry OOMs of RmmRapidsRetryIterator
+(GpuRetryOOM / GpuSplitAndRetryOOM) extended to the distributed fault
+model Theseus-class engines need (PAPERS.md): corrupted payloads,
+crashed stages and tripped watchdogs are *recoverable, typed* events —
+the runner re-executes from lineage or walks down the degradation
+ladder instead of consuming garbage or hanging.
+
+This module must stay import-light (no engine imports): it is imported
+by memory/, shuffle/, parallel/ and exec/ alike.
+"""
+from __future__ import annotations
+
+
+class TpuFaultError(RuntimeError):
+    """Base of every recoverable distributed fault.  The degradation
+    ladder (fault/ladder.py, Session.execute) catches exactly this
+    family — anything else is a genuine bug and must surface."""
+
+    def __init__(self, *args, site: str = "", injected: bool = False):
+        super().__init__(*args)
+        #: checkpoint site that raised (e.g. ``spill.write``)
+        self.site = site
+        #: True when raised by the fault injector (test mode) rather
+        #: than by a real corruption/crash/timeout
+        self.injected = injected
+
+
+class TpuPayloadCorruption(TpuFaultError):
+    """A spill/shuffle/exchange payload failed its CRC32C verification
+    on read.  The producing stage must be re-executed from lineage —
+    the corrupted bytes must never reach an operator."""
+
+
+class TpuStageCrash(TpuFaultError):
+    """A stage (or leaf drain) died mid-execution.  Lineage is explicit
+    in the stage plan, so the failed stage is re-executed bounded by
+    ``fault.maxStageRetries``."""
+
+
+class TpuStageTimeout(TpuFaultError):
+    """A stage watchdog deadline (``fault.stageTimeoutMs``) expired, or
+    a bounded producer/consumer queue made no progress past its
+    deadline — the hung unit of work is abandoned and re-executed
+    instead of blocking the query forever."""

@@ -1,0 +1,279 @@
+"""Deterministic fault injection — the generalized form of PR-1's
+``OomInjector``.
+
+Reference analogue: the RMM OOM-injection test mode
+(``RmmSpark.forceRetryOOM`` / ``forceSplitAndRetryOOM``) widened to the
+full distributed fault model: every recovery path of the engine —
+spill-frame corruption, exchange/stage crashes, stragglers tripping
+watchdogs — can be driven deterministically in CI on CPU-only JAX,
+without real hardware faults.
+
+Fault types (``spark.rapids.tpu.fault.injection.type``):
+
+* ``oom``         — raise the typed retry OOM at the checkpoint (the
+  PR-1 behavior; ``oomType`` picks retry vs split).
+* ``corrupt``     — flip a byte in the next matching payload written
+  through a checksummed boundary (spill frame / host round-trip); the
+  CRC32C verification on the read side must detect it and trigger
+  recompute-from-lineage.
+* ``delay``       — sleep ``delayMs`` at the checkpoint (a straggler);
+  with a stage watchdog armed this trips ``fault.stageTimeoutMs``.
+* ``stage_crash`` — raise :class:`~.errors.TpuStageCrash` at the
+  checkpoint (a died executor/stage).
+
+Modes (``spark.rapids.tpu.fault.injection.mode``) are exactly PR-1's:
+``none`` (off), ``nth`` (fire once at matching checkpoint #skipCount),
+``random`` (seeded, suppressed during recovery so progress is
+guaranteed), ``always`` (every matching checkpoint — proves bounded
+retries exhaust into the degradation ladder, not an infinite loop).
+
+``site`` filters checkpoints by substring (e.g. ``stage.run`` fires
+only at stage boundaries), so a sweep can target one recovery path at
+a time; only matching checkpoints advance the counter, keeping
+``skipCount`` deterministic per site class.
+
+The injection-suppression thread-locals (``_shield`` — hard off inside
+the recovery machinery itself; ``_recovering`` — soft off while a
+combinator re-executes a failed attempt) live HERE and are shared with
+``memory/retry.py`` so one suppression scope covers every injector.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+FAULT_TYPES = ("oom", "corrupt", "delay", "stage_crash")
+
+# ==========================================================================
+# Injection-suppression scopes (moved from memory/retry.py; see module
+# docstring there for the original rationale)
+# ==========================================================================
+_tl = threading.local()
+
+#: process-wide count of live scopes (all threads): the suppression
+#: decision stays thread-local, but leak DETECTION must see scopes
+#: opened on pool/watchdog threads too — a thread-local-only check on
+#: the test's main thread could never catch them
+_scope_lock = threading.Lock()
+_active_scopes = 0
+
+
+def _recovery_depth() -> int:
+    return getattr(_tl, "recovery", 0)
+
+
+def _shield_depth() -> int:
+    return getattr(_tl, "shield", 0)
+
+
+def _scope_delta(d: int) -> None:
+    global _active_scopes
+    with _scope_lock:
+        _active_scopes += d
+
+
+class _shield:
+    """Hard-off injection guard for framework internals (checkpointing,
+    spilling during recovery) — even ``always`` mode must not fire while
+    the recovery machinery itself allocates."""
+
+    def __enter__(self):
+        _tl.shield = _shield_depth() + 1
+        _scope_delta(1)
+        return self
+
+    def __exit__(self, *exc):
+        _tl.shield = _shield_depth() - 1
+        _scope_delta(-1)
+
+
+class _recovering:
+    def __enter__(self):
+        _tl.recovery = _recovery_depth() + 1
+        _scope_delta(1)
+        return self
+
+    def __exit__(self, *exc):
+        _tl.recovery = _recovery_depth() - 1
+        _scope_delta(-1)
+
+
+def recovery_in_flight() -> bool:
+    """True when ANY thread still holds a recovery/shield scope (plus
+    the caller's own thread-local depths as a fast path) — the conftest
+    leak check asserts this is False between tests.  Abandoned daemon
+    threads (watchdog-orphaned attempts) may legitimately hold scopes
+    briefly; callers comparing across a test boundary see those drain
+    with the attempt."""
+    return _shield_depth() != 0 or _recovery_depth() != 0 \
+        or _active_scopes != 0
+
+
+# ==========================================================================
+# The generalized injector
+# ==========================================================================
+class FaultInjector:
+    """Deterministic multi-fault injector.  ``check(site)`` is the
+    raising/delaying checkpoint hook; ``should_corrupt(site)`` is the
+    write-path hook a checksummed boundary consults before deciding to
+    damage its payload.  Both share one checkpoint counter so a
+    ``skipCount`` sweep walks every matching checkpoint in order."""
+
+    #: injection probability for mode=random (seeded, see ``seed``)
+    RANDOM_PROBABILITY = 0.25
+
+    def __init__(self, mode: str = "none", skip_count: int = 0,
+                 seed: int = 0, fault_type: str = "oom",
+                 site: str = "", delay_ms: float = 50.0,
+                 oom_type: str = "retry"):
+        mode = (mode or "none").lower()
+        if mode not in ("none", "always", "nth", "random"):
+            raise ValueError(
+                f"faultInjection.mode must be none|always|nth|random, "
+                f"got {mode!r}")
+        fault_type = (fault_type or "oom").lower()
+        if fault_type not in FAULT_TYPES:
+            raise ValueError(
+                f"faultInjection.type must be one of "
+                f"{'|'.join(FAULT_TYPES)}, got {fault_type!r}")
+        oom_type = (oom_type or "retry").lower()
+        if oom_type not in ("retry", "split"):
+            raise ValueError(
+                f"oomType must be retry|split, got {oom_type!r}")
+        self.mode = mode
+        self.skip_count = max(0, int(skip_count))
+        self.seed = int(seed)
+        self.fault_type = fault_type
+        self.site = site or ""
+        self.delay_ms = max(0.0, float(delay_ms))
+        self.oom_type = oom_type
+        self._rng = random.Random(self.seed)
+        self._count = 0
+        self._armed = True
+        self._injected = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_conf(cls, conf) -> "FaultInjector":
+        from ..config import (FAULT_INJECTION_DELAY_MS,
+                              FAULT_INJECTION_MODE, FAULT_INJECTION_SEED,
+                              FAULT_INJECTION_SITE,
+                              FAULT_INJECTION_SKIP_COUNT,
+                              FAULT_INJECTION_TYPE)
+
+        return cls(mode=conf.get(FAULT_INJECTION_MODE),
+                   skip_count=conf.get(FAULT_INJECTION_SKIP_COUNT),
+                   seed=conf.get(FAULT_INJECTION_SEED),
+                   fault_type=conf.get(FAULT_INJECTION_TYPE),
+                   site=conf.get(FAULT_INJECTION_SITE),
+                   delay_ms=conf.get(FAULT_INJECTION_DELAY_MS))
+
+    # ------------------------------------------------------------------
+    @property
+    def checkpoints_seen(self) -> int:
+        return self._count
+
+    @property
+    def injections_fired(self) -> int:
+        return self._injected
+
+    def _site_matches(self, site: str) -> bool:
+        return not self.site or self.site in (site or "")
+
+    def _decide(self, site: str) -> bool:
+        """Shared fire decision: counts the (matching) checkpoint and
+        applies the mode policy.  Returns True when this checkpoint
+        faults."""
+        if self.mode == "none" or _shield_depth() > 0:
+            return False
+        if self.mode == "random" and _recovery_depth() > 0:
+            return False
+        if not self._site_matches(site):
+            return False
+        with self._lock:
+            n = self._count
+            self._count += 1
+            if self.mode == "always":
+                fire = True
+            elif self.mode == "nth":
+                fire = self._armed and n == self.skip_count
+                if fire:
+                    self._armed = False
+            else:  # random
+                fire = self._rng.random() < self.RANDOM_PROBABILITY
+            if fire:
+                self._injected += 1
+        return fire
+
+    # ------------------------------------------------------------------
+    def check(self, site: str = "") -> None:
+        """Raising/delaying checkpoint: called at spill reads/writes,
+        exchange steps, stage boundaries and leaf drains.  ``corrupt``
+        injectors never fire here — corruption happens on the write
+        path via :meth:`should_corrupt`."""
+        if self.fault_type == "corrupt":
+            return
+        if not self._decide(site):
+            return
+        if self.fault_type == "delay":
+            time.sleep(self.delay_ms / 1000.0)
+            return
+        if self.fault_type == "stage_crash":
+            from .errors import TpuStageCrash
+
+            raise TpuStageCrash(
+                f"injected stage crash (mode={self.mode}, "
+                f"site={site or '?'})", site=site, injected=True)
+        # fault_type == "oom"
+        from ..memory.retry import TpuRetryOOM, TpuSplitAndRetryOOM
+
+        exc = TpuSplitAndRetryOOM if self.oom_type == "split" \
+            else TpuRetryOOM
+        raise exc(
+            f"injected OOM (mode={self.mode}, site={site or '?'})",
+            injected=True)
+
+    def should_corrupt(self, site: str = "") -> bool:
+        """Write-path checkpoint for checksummed boundaries: True when
+        the payload being written at ``site`` must be damaged so the
+        read-side CRC verification has something to catch."""
+        if self.fault_type != "corrupt":
+            return False
+        return self._decide(site)
+
+
+# ==========================================================================
+# Process-wide fault injector slot — (re)installed at query start from
+# the query's conf (ExecContext), per query so a skipCount sweep resets
+# its checkpoint counter every run.  Lives NEXT TO (not instead of) the
+# legacy OOM injector slot in memory/retry.py: the PR-1 oomInjection.*
+# confs keep their exact semantics while fault.* drives the wider model.
+# ==========================================================================
+_injector_lock = threading.Lock()
+_fault_injector: Optional[FaultInjector] = None
+
+
+def install_fault_injector(inj: Optional[FaultInjector]) -> None:
+    global _fault_injector
+    with _injector_lock:
+        _fault_injector = inj
+
+
+def get_fault_injector() -> Optional[FaultInjector]:
+    return _fault_injector
+
+
+def maybe_inject_fault(site: str = "") -> None:
+    """Fault checkpoint hook (raising/delaying types).  Wired at every
+    spill write/read, exchange step, stage boundary and leaf drain."""
+    inj = _fault_injector
+    if inj is not None:
+        inj.check(site)
+
+
+def maybe_corrupt(site: str = "") -> bool:
+    """Write-path corruption decision for checksummed boundaries."""
+    inj = _fault_injector
+    return inj is not None and inj.should_corrupt(site)

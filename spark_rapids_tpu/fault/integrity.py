@@ -1,0 +1,147 @@
+"""Payload integrity: CRC32C checksums over spill frames and exchange
+host round-trips.
+
+Reference analogue: the UCX shuffle's per-transfer metadata validation
+(TableMeta riding every buffer) — here strengthened to a content
+checksum, because a TPU spill frame crosses host RAM and disk where
+bit-rot and torn writes are real.  Checksums are computed ONCE on the
+write side (spill-frame serialization, exchange host staging) and
+verified on the read side; a mismatch raises
+:class:`~.errors.TpuPayloadCorruption`, which triggers
+recompute-from-lineage of the producing stage instead of consuming
+garbage.
+
+CRC32C (Castagnoli) is used when a native implementation is available
+(``crc32c`` / ``google_crc32c``); otherwise the zlib CRC32 fallback
+keeps the identical detect-and-recompute semantics (the polynomial only
+matters for cross-system interchange, which spill frames never do —
+they are written and read by the same process family).
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Iterable, List
+
+import numpy as np
+
+from .errors import TpuPayloadCorruption
+from .stats import GLOBAL as _stats
+
+try:  # native Castagnoli CRC when the wheel is present
+    import crc32c as _crc32c_mod
+
+    def _crc(data, value: int = 0) -> int:
+        return _crc32c_mod.crc32c(data, value)
+except Exception:  # noqa: BLE001 — no new deps: zlib fallback
+    try:
+        import google_crc32c as _gcrc
+
+        def _crc(data, value: int = 0) -> int:
+            return _gcrc.extend(value, bytes(data))
+    except Exception:  # noqa: BLE001
+        def _crc(data, value: int = 0) -> int:
+            return zlib.crc32(data, value)
+
+
+def crc32c(data, value: int = 0) -> int:
+    """Checksum of a bytes-like or uint8 ndarray (accumulating form)."""
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+    return _crc(data, value) & 0xFFFFFFFF
+
+
+def checksum_frame(frame: np.ndarray) -> int:
+    """Checksum of one contiguous serialized spill frame."""
+    return crc32c(frame)
+
+
+def verify_frame(frame: np.ndarray, expected: int, site: str,
+                 detail: str = "") -> None:
+    got = checksum_frame(frame)
+    if got != expected:
+        _stats.add("numChecksumFailures", 1)
+        raise TpuPayloadCorruption(
+            f"payload checksum mismatch at {site}: "
+            f"crc32c=0x{got:08x} expected=0x{expected:08x}"
+            + (f" ({detail})" if detail else ""), site=site)
+
+
+# ----- host-batch checksums (exchange host round-trips) -------------------
+def _column_crc(col, value: int) -> int:
+    data = col.data
+    if isinstance(data, np.ndarray) and data.dtype == object:
+        # string columns: hash the encoded values (None-safe)
+        for v in data:
+            b = b"\x00" if v is None else (
+                v.encode("utf-8") if isinstance(v, str) else bytes(v))
+            value = _crc(b, value)
+    else:
+        value = crc32c(np.asarray(data), value)
+    if col.validity is not None:
+        value = crc32c(
+            np.ascontiguousarray(col.validity).astype(np.uint8), value)
+    return value
+
+
+def checksum_host_batch(hb) -> int:
+    """Content checksum of one HostBatch (column data + validity)."""
+    value = crc32c(np.asarray([hb.num_rows], dtype=np.int64))
+    for col in hb.columns:
+        value = _column_crc(col, value)
+    return value & 0xFFFFFFFF
+
+
+def stamp_host_batches(batches: Iterable) -> List[int]:
+    """Write-side stamps for a host round-trip (one crc per batch)."""
+    return [checksum_host_batch(b) for b in batches]
+
+
+def verify_host_batches(batches, stamps: List[int], site: str) -> None:
+    """Read-side verification of a stamped host round-trip."""
+    for i, (b, expected) in enumerate(zip(batches, stamps)):
+        got = checksum_host_batch(b)
+        if got != expected:
+            _stats.add("numChecksumFailures", 1)
+            raise TpuPayloadCorruption(
+                f"host round-trip checksum mismatch at {site} "
+                f"(batch {i}): crc32c=0x{got:08x} "
+                f"expected=0x{expected:08x}", site=site)
+
+
+def corrupted_copy(hb):
+    """Injection helper: a DEEP copy of ``hb`` with one byte flipped in
+    its first non-empty numeric column.  A copy (never in-place) so the
+    damage cannot alias cached uploads or user-owned source arrays —
+    the clean retry must see clean data."""
+    from ..data.column import HostBatch, HostColumn
+
+    cols = []
+    flipped = False
+    for col in hb.columns:
+        data = col.data.copy() if isinstance(col.data, np.ndarray) \
+            else col.data
+        if not flipped and isinstance(data, np.ndarray) \
+                and data.dtype != object and data.nbytes:
+            flat = data.view(np.uint8).reshape(-1)
+            flat[flat.shape[0] // 2] ^= 0xFF
+            flipped = True
+        validity = col.validity.copy() if col.validity is not None \
+            else None
+        cols.append(HostColumn(col.dtype, data, validity))
+    return HostBatch(hb.schema, cols)
+
+
+def corrupt_host_batch(hb) -> None:
+    """Injection helper: flip one byte of the first non-empty numeric
+    column IN PLACE (the read-side verify must catch it).  Host batches
+    from device downloads own their arrays, so the flip never aliases
+    user data."""
+    for col in hb.columns:
+        data = col.data
+        if isinstance(data, np.ndarray) and data.dtype != object \
+                and data.nbytes:
+            flat = data.view(np.uint8).reshape(-1)
+            if not flat.flags.writeable:
+                continue
+            flat[flat.shape[0] // 2] ^= 0xFF
+            return

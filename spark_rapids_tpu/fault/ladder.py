@@ -1,0 +1,73 @@
+"""The graceful-degradation ladder.
+
+Reference analogue: the plugin's core promise — transparent fallback
+with bit-identical results (SURVEY §L0).  PR-1 made single-device OOMs
+recoverable; this module makes *query-level* fault exhaustion
+recoverable: when a distributed execution exhausts its bounded stage
+retries, the query walks DOWN the ladder instead of failing —
+
+    rung 0: distributed SPMD execution (the native plan)
+    rung 1: single-process device execution (``Session.execute``)
+    rung 2: the CPU-exec plan (``plan.overrides.cpu_exec_plan`` — no
+            TPU overrides at all; the oracle engine)
+
+Every rung produces bit-identical results by construction (the host
+engine is the equality oracle the device plan is tested against), so
+degradation trades throughput for availability, never correctness.
+
+The final rung is surfaced as ``fault.degradeLevel`` in
+``Session.last_metrics`` next to the retry counters, and a DEGRADED
+warning rides the trace log — a degraded query must be visibly
+degraded.  Rung 1 -> 2 lives inside ``Session.execute`` itself (the
+single-process path has its own fault exposure); this module drives
+rung 0 -> 1.
+"""
+from __future__ import annotations
+
+import logging
+
+from .errors import TpuFaultError
+from .stats import DEGRADE_SINGLE_PROCESS, GLOBAL as _stats
+from .stats import fault_summary
+
+log = logging.getLogger(__name__)
+
+
+def run_with_fault_tolerance(session, df, mesh=None, n_devices: int = 8):
+    """Execute ``df`` distributed with the full fault-tolerance
+    protocol: bounded stage re-execution inside the runner, then the
+    degradation ladder on exhaustion.  Returns the collected HostBatch;
+    ``session.last_metrics`` carries the ``fault.*`` counters and the
+    final ``degradeLevel``."""
+    from ..config import FAULT_DEGRADE_ENABLED
+    from ..parallel.runner import run_distributed
+
+    try:
+        out = run_distributed(session, df, mesh=mesh,
+                              n_devices=n_devices)
+        session.last_metrics = dict(
+            getattr(session, "last_metrics", None) or {})
+        session.last_metrics.update(_stats.snapshot())
+        return out
+    except TpuFaultError as e:
+        if not session.conf.get(FAULT_DEGRADE_ENABLED):
+            raise
+        # carry the distributed attempt's counters across the rung —
+        # Session.execute re-arms the per-query stats
+        pre = _stats.snapshot()
+        log.warning(
+            "distributed execution exhausted fault recovery (%s: %s) — "
+            "DEGRADED to the single-process rung", type(e).__name__, e)
+        out = session.execute(df.plan)  # rung 1 (rung 2 lives inside)
+        merged = dict(session.last_metrics or {})
+        for k, v in pre.items():
+            if k != "fault.degradeLevel":
+                merged[k] = merged.get(k, 0) + v
+        merged["fault.degradeLevel"] = max(
+            merged.get("fault.degradeLevel", 0), DEGRADE_SINGLE_PROCESS)
+        _stats.set_max("degradeLevel", merged["fault.degradeLevel"])
+        session.last_metrics = merged
+        summary = fault_summary(merged)
+        if summary:
+            log.warning("query completed DEGRADED: %s", summary)
+        return out

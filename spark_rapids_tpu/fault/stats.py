@@ -1,0 +1,74 @@
+"""Per-query fault-tolerance counters.
+
+Reference analogue: the retry metrics of the RMM retry framework
+surfaced in the SQL UI — a degraded query must be VISIBLY degraded.
+The counters here are process-global (the spill framework and the
+distributed runner have no per-exec metrics registry) and are reset at
+query start by ``ExecContext`` exactly like the fault injector; the
+session merges the snapshot into ``Session.last_metrics`` under
+``fault.*`` keys at query end.
+
+Counters:
+
+* ``fault.numStageRetries``     — stage/leaf re-executions from lineage
+* ``fault.numChecksumFailures`` — CRC32C mismatches detected on read
+* ``fault.numWatchdogTrips``    — stage/queue watchdog deadlines hit
+* ``fault.degradeLevel``        — final ladder rung (0 = native plan,
+  1 = single-process fallback, 2 = CPU-exec plan)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+#: degradation-ladder rungs (fault/ladder.py walks these in order)
+DEGRADE_NONE = 0
+DEGRADE_SINGLE_PROCESS = 1
+DEGRADE_CPU = 2
+
+_COUNTERS = ("numStageRetries", "numChecksumFailures",
+             "numWatchdogTrips", "degradeLevel")
+
+
+class FaultStats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[str, int] = {k: 0 for k in _COUNTERS}
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in _COUNTERS:
+                self._values[k] = 0
+
+    def add(self, name: str, v: int = 1) -> None:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + v
+
+    def set_max(self, name: str, v: int) -> None:
+        with self._lock:
+            self._values[name] = max(self._values.get(name, 0), v)
+
+    def get(self, name: str) -> int:
+        return self._values.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        """``fault.*``-prefixed snapshot for ``Session.last_metrics``."""
+        with self._lock:
+            return {f"fault.{k}": v for k, v in self._values.items()}
+
+
+#: the process-wide instance (reset per query by ExecContext)
+GLOBAL = FaultStats()
+
+
+def fault_summary(metric_snapshot) -> str:
+    """One-line summary of the fault counters in a metrics snapshot;
+    empty string when the query saw no faults (mirrors
+    ``memory.retry.retry_summary``)."""
+    keys = tuple(f"fault.{k}" for k in _COUNTERS)
+    vals = {k: metric_snapshot.get(k, 0) for k in keys}
+    if not any(vals.values()):
+        return ""
+    return ("numStageRetries=%d numChecksumFailures=%d "
+            "numWatchdogTrips=%d degradeLevel=%d"
+            % tuple(vals[k] for k in keys))

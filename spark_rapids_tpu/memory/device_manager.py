@@ -18,6 +18,7 @@ from ..config import (
     CONCURRENT_TPU_TASKS,
     DEVICE_MEMORY_DEBUG,
     DEVICE_MEMORY_FRACTION,
+    FAULT_SEMAPHORE_TIMEOUT_MS,
     TpuConf,
 )
 from .semaphore import DeviceSemaphore
@@ -50,7 +51,15 @@ class DeviceManager:
         total = self._query_memory()
         self.arena_bytes = int(total * conf.get(DEVICE_MEMORY_FRACTION))
         self.debug = conf.get(DEVICE_MEMORY_DEBUG)
-        self.semaphore = DeviceSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+        # acquire watchdog: fault.semaphoreTimeoutMs (0 = the class's
+        # built-in default) — its DeviceSemaphoreTimeout is a retryable
+        # fault the degradation ladder recovers on
+        sem_timeout_ms = conf.get(FAULT_SEMAPHORE_TIMEOUT_MS)
+        self.semaphore = DeviceSemaphore(
+            conf.get(CONCURRENT_TPU_TASKS),
+            acquire_timeout=(sem_timeout_ms / 1000.0
+                             if sem_timeout_ms and sem_timeout_ms > 0
+                             else None))
         self._allocated = 0
         self._alloc_lock = threading.Lock()
         self._peak = 0

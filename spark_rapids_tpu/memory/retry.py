@@ -62,93 +62,35 @@ class TpuSplitAndRetryOOM(TpuRetryOOM):
 
 
 # ==========================================================================
-# Deterministic OOM injection
+# Deterministic OOM injection — now a specialization of the generalized
+# FaultInjector (fault/injector.py); the injection-suppression scopes
+# (_shield / _recovering) are shared with it so one scope covers every
+# injector.  ``random`` mode skips injection during recovery so a retry
+# can always make progress; ``always`` mode keeps firing (that IS its
+# point — driving split-retry to the minSplitRows floor); ``nth`` is
+# one-shot by construction.
 # ==========================================================================
-#: soft suppression depth: >0 while a combinator re-executes a failed
-#: attempt.  ``random`` mode skips injection here so a retry can always
-#: make progress; ``always`` mode keeps firing (that IS its point —
-#: driving split-retry to the minSplitRows floor), ``nth`` is one-shot
-#: by construction.
-_tl = threading.local()
+from ..fault.injector import (FaultInjector, _recovering, _recovery_depth,
+                              _shield, _shield_depth)  # noqa: E402,F401
 
 
-def _recovery_depth() -> int:
-    return getattr(_tl, "recovery", 0)
-
-
-def _shield_depth() -> int:
-    return getattr(_tl, "shield", 0)
-
-
-class _shield:
-    """Hard-off injection guard for framework internals (checkpointing,
-    spilling during recovery) — even ``always`` mode must not fire while
-    the recovery machinery itself allocates."""
-
-    def __enter__(self):
-        _tl.shield = _shield_depth() + 1
-        return self
-
-    def __exit__(self, *exc):
-        _tl.shield = _shield_depth() - 1
-
-
-class _recovering:
-    def __enter__(self):
-        _tl.recovery = _recovery_depth() + 1
-        return self
-
-    def __exit__(self, *exc):
-        _tl.recovery = _recovery_depth() - 1
-
-
-class OomInjector:
+class OomInjector(FaultInjector):
     """Deterministic allocation-failure injector (reference: the RMM
     OOM-injection mode behind ``RmmSpark.forceRetryOOM`` /
-    ``forceSplitAndRetryOOM``).
+    ``forceSplitAndRetryOOM``) — the PR-1 surface, preserved as the
+    ``oom`` specialization of :class:`~..fault.injector.FaultInjector`.
 
-    Modes (``spark.rapids.tpu.memory.oomInjection.mode``):
-
-    * ``none``   — disabled (production default).
-    * ``nth``    — fire exactly ONCE, at global allocation checkpoint
-      number ``skipCount`` (0-based), then disarm.  Sweeping skipCount
-      0..N drives an OOM through every checkpoint of a pipeline, one
-      run at a time — each run must still produce bit-identical results.
-    * ``random`` — fire with a seeded pseudo-random probability at each
-      checkpoint, but never while a combinator is re-executing a failed
-      attempt (so recovery always makes progress).
-    * ``always`` — fire at EVERY checkpoint, including retries.  Only
-      useful to prove the bottom-out path: split-retry must halve down
-      to ``retry.minSplitRows`` and then surface a diagnostic.
-
-    ``oomType`` selects the raised type: ``retry`` -> TpuRetryOOM,
-    ``split`` -> TpuSplitAndRetryOOM.
+    Modes (``spark.rapids.tpu.memory.oomInjection.mode``): ``none``,
+    ``nth`` (fire once at allocation checkpoint #skipCount), ``random``
+    (seeded, suppressed during recovery), ``always`` (every
+    checkpoint).  ``oomType`` selects the raised type: ``retry`` ->
+    TpuRetryOOM, ``split`` -> TpuSplitAndRetryOOM.
     """
-
-    #: injection probability for mode=random (seeded, see ``seed``)
-    RANDOM_PROBABILITY = 0.25
 
     def __init__(self, mode: str = "none", skip_count: int = 0,
                  seed: int = 0, oom_type: str = "retry"):
-        mode = (mode or "none").lower()
-        if mode not in ("none", "always", "nth", "random"):
-            raise ValueError(
-                f"oomInjection.mode must be none|always|nth|random, "
-                f"got {mode!r}")
-        oom_type = (oom_type or "retry").lower()
-        if oom_type not in ("retry", "split"):
-            raise ValueError(
-                f"oomInjection.oomType must be retry|split, "
-                f"got {oom_type!r}")
-        self.mode = mode
-        self.skip_count = max(0, int(skip_count))
-        self.seed = int(seed)
-        self.oom_type = oom_type
-        self._rng = random.Random(self.seed)
-        self._count = 0
-        self._armed = True
-        self._injected = 0
-        self._lock = threading.Lock()
+        super().__init__(mode=mode, skip_count=skip_count, seed=seed,
+                         fault_type="oom", oom_type=oom_type)
 
     @classmethod
     def from_conf(cls, conf) -> "OomInjector":
@@ -159,41 +101,6 @@ class OomInjector:
                    skip_count=conf.get(OOM_INJECTION_SKIP_COUNT),
                    seed=conf.get(OOM_INJECTION_SEED),
                    oom_type=conf.get(OOM_INJECTION_TYPE))
-
-    @property
-    def checkpoints_seen(self) -> int:
-        return self._count
-
-    @property
-    def injections_fired(self) -> int:
-        return self._injected
-
-    def check(self, site: str = "") -> None:
-        """One allocation checkpoint; raises the configured OOM type when
-        the injection policy says this one fails."""
-        if self.mode == "none" or _shield_depth() > 0:
-            return
-        if self.mode == "random" and _recovery_depth() > 0:
-            return
-        with self._lock:
-            n = self._count
-            self._count += 1
-            if self.mode == "always":
-                fire = True
-            elif self.mode == "nth":
-                fire = self._armed and n == self.skip_count
-                if fire:
-                    self._armed = False
-            else:  # random
-                fire = self._rng.random() < self.RANDOM_PROBABILITY
-            if fire:
-                self._injected += 1
-        if fire:
-            exc = TpuSplitAndRetryOOM if self.oom_type == "split" \
-                else TpuRetryOOM
-            raise exc(
-                f"injected OOM (mode={self.mode}, checkpoint #{n}, "
-                f"site={site or '?'})", injected=True)
 
 
 #: process-wide injector, (re)installed at query start from the query's

@@ -22,11 +22,18 @@ from __future__ import annotations
 
 import threading
 
+from ..fault.errors import TpuFaultError
 
-class DeviceSemaphoreTimeout(RuntimeError):
+
+class DeviceSemaphoreTimeout(TpuFaultError):
     """A device-semaphore acquire blocked past the watchdog deadline —
     almost always a leaked permit (a task thread that exited without
-    ``release_all``) or a hold-while-blocked cycle."""
+    ``release_all``) or a hold-while-blocked cycle.  A
+    :class:`~..fault.errors.TpuFaultError`: task-level retry re-executes
+    the partition's lineage and the degradation ladder can fall back a
+    rung instead of crashing the query.  The deadline is configurable
+    via ``spark.rapids.tpu.fault.semaphoreTimeoutMs`` (wired in
+    DeviceManager)."""
 
 
 class DeviceSemaphore:

@@ -82,6 +82,12 @@ class SpillableBuffer:
         self._min_bucket = max(batch.padded_rows, 1)
         self.refcount = 0
         self.lock = threading.Lock()
+        #: CRC32C of the serialized frame, computed once on the first
+        #: device->host spill and verified on every load (host or disk)
+        #: — a mismatch raises TpuPayloadCorruption so recompute-from-
+        #: lineage runs instead of deserializing garbage
+        self.crc: Optional[int] = None
+        self.checksum_enabled = True
 
     # ----- tier movement ---------------------------------------------------
     def to_host(self, arena=None) -> None:
@@ -108,6 +114,10 @@ class SpillableBuffer:
         self._frame = frame
         self._device = None
         self.tier = StorageTier.HOST
+        if self.checksum_enabled:
+            from ..fault.integrity import checksum_frame
+
+            self.crc = checksum_frame(self._host_frame())
 
     def _host_frame(self) -> np.ndarray:
         if self._arena_alloc is not None:
@@ -130,14 +140,29 @@ class SpillableBuffer:
         self._disk_path = path
         self.tier = StorageTier.DISK
 
+    def corrupt_payload(self) -> None:
+        """Fault-injection hook: flip one byte of the host frame AFTER
+        the checksum was stamped, so the read-side verification has a
+        genuine mismatch to catch."""
+        frame = self._host_frame()
+        if frame is not None and frame.nbytes:
+            frame[frame.nbytes // 2] ^= 0xFF
+
     def _load_host(self) -> HostBatch:
         from ..native import serializer
 
         if self.tier == StorageTier.HOST:
             frame = self._host_frame()
+            site = "spill.read.host"
         else:
             assert self.tier == StorageTier.DISK
             frame = np.fromfile(self._disk_path, dtype=np.uint8)
+            site = "spill.read.disk"
+        if self.crc is not None:
+            from ..fault.integrity import verify_frame
+
+            verify_frame(frame, self.crc, site,
+                         detail=f"buffer {self.id}, {frame.nbytes}B")
         return serializer.deserialize(frame, self.schema)
 
     def get_device_batch(self) -> DeviceBatch:
@@ -242,6 +267,8 @@ class SpillFramework:
         #: device tier (consumers drop derived device-side state, e.g.
         #: the exchange's cached partition ids)
         self.spill_listeners: List = []
+        #: stamp + verify CRC32C on spill frames (fault.checksum.enabled)
+        self.checksum_enabled = True
 
     def _track_device(self, delta: int) -> None:
         dm = self.device_manager
@@ -267,14 +294,23 @@ class SpillFramework:
 
     # ----- store API -------------------------------------------------------
     def add_batch(self, batch: DeviceBatch,
-                  priority: Optional[float] = None) -> int:
+                  priority: Optional[float] = None,
+                  site: str = "spill.write") -> int:
         """Register a device batch as spillable; returns its id
-        (reference: RapidsDeviceMemoryStore.addTable)."""
+        (reference: RapidsDeviceMemoryStore.addTable).  ``site`` names
+        the write boundary for fault injection (``spill.write`` for
+        plain spills, ``exchange.write`` for shuffle map output,
+        ``upload.cache`` for cached uploads): a ``corrupt`` injection
+        here spills the fresh buffer to host and flips a byte of its
+        frame, so the read-side CRC verification must catch it."""
+        from ..fault.injector import maybe_corrupt
+
         with self._lock:
             buf = SpillableBuffer(
                 self.catalog.next_id(), batch,
                 SpillPriorities.output_for_read()
                 if priority is None else priority)
+            buf.checksum_enabled = self.checksum_enabled
             self.catalog.register(buf)
             self.device_queue.push(buf.id, buf.priority)
             self.device_bytes += buf.size
@@ -290,6 +326,16 @@ class SpillFramework:
             if self.device_limit is not None \
                     and self.device_bytes > self.device_limit:
                 self.spill_device_to_target(self.device_limit)
+            if maybe_corrupt(site):
+                # silently damage this payload where it is parked: the
+                # stamped checksum stays good, the bytes do not.  The
+                # buffer may ALREADY be on the host tier (the pressure
+                # spill above demoted it) — corrupt it there rather
+                # than wasting the injector's one-shot
+                if buf.tier == StorageTier.DEVICE:
+                    self._demote_to_host(buf)
+                if buf.tier == StorageTier.HOST:
+                    buf.corrupt_payload()
             return buf.id
 
     def acquire_batch(self, buf_id: int) -> DeviceBatch:
@@ -299,6 +345,9 @@ class SpillFramework:
         so an OOM (real or injected) leaves the buffer untouched on its
         current tier, unpinned, for the retry framework to re-acquire
         after recovery."""
+        from ..fault.injector import maybe_inject_fault
+
+        maybe_inject_fault("spill.read")
         buf = self.catalog.acquire(buf_id)
         try:
             with self._lock:
@@ -357,21 +406,27 @@ class SpillFramework:
                 if victim_id is None:
                     break  # everything pinned
                 buf = self.catalog.get(victim_id)
-                self.device_queue.remove(victim_id)
-                buf.to_host(self.host_arena)
-                self.device_bytes -= buf.size
-                self._track_device(-buf.size)
-                self.host_bytes += buf.size
-                self.host_queue.push(buf.id, buf.priority)
-                spilled += buf.size
-                self.metrics["spill_to_host"] += 1
-                self.metrics["bytes_spilled"] += buf.size
-                for cb in list(self.spill_listeners):
-                    cb(victim_id)
+                spilled += self._demote_to_host(buf)
                 self._maybe_spill_host_to_disk()
         if spilled:
             log.info("spilled %d bytes device->host", spilled)
         return spilled
+
+    def _demote_to_host(self, buf: SpillableBuffer) -> int:
+        """Move one DEVICE-tier buffer to the host tier with full
+        accounting + listener fan-out (caller holds the lock).  Shared
+        by the pressure spiller and the corruption-injection path."""
+        self.device_queue.remove(buf.id)
+        buf.to_host(self.host_arena)
+        self.device_bytes -= buf.size
+        self._track_device(-buf.size)
+        self.host_bytes += buf.size
+        self.host_queue.push(buf.id, buf.priority)
+        self.metrics["spill_to_host"] += 1
+        self.metrics["bytes_spilled"] += buf.size
+        for cb in list(self.spill_listeners):
+            cb(buf.id)
+        return buf.size
 
     def _pick_device_victim(self) -> Optional[int]:
         # lowest priority, skipping pinned buffers
@@ -439,7 +494,7 @@ def install(device_manager, conf=None) -> SpillFramework:
     """Create/fetch the framework and hook it to the device manager's
     alloc accounting (reference: GpuShuffleEnv.initStorage +
     Rmm.setEventHandler)."""
-    from ..config import HOST_SPILL_STORAGE_SIZE
+    from ..config import FAULT_CHECKSUM_ENABLED, HOST_SPILL_STORAGE_SIZE
 
     with SpillFramework._ilock:
         if SpillFramework._instance is None:
@@ -450,6 +505,8 @@ def install(device_manager, conf=None) -> SpillFramework:
                 device_limit_bytes=device_manager.arena_bytes)
         fw = SpillFramework._instance
     fw.device_manager = device_manager
+    if conf is not None:
+        fw.checksum_enabled = conf.get(FAULT_CHECKSUM_ENABLED)
     if device_manager.event_handler is None:
         device_manager.event_handler = MemoryEventHandler(
             fw, device_manager.arena_bytes)

@@ -173,9 +173,11 @@ def exchange_step(mesh, fn):
     """Wrap ``fn(local_batch) -> local_batch`` (which may call
     collective_exchange) in shard_map over the mesh's data axis,
     operating on stacked [n_parts, ...] DeviceBatch pytrees."""
-    import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from ._compat import get_shard_map
+
+    shard_map = get_shard_map()
 
     axis = mesh.axis_names[0]
 

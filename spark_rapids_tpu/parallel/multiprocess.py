@@ -141,6 +141,9 @@ class MultiProcessRunner(DistributedRunner):
             sem = ctx.session.device_manager.semaphore
 
         def drain(pid: int) -> List[HostBatch]:
+            from ..fault.injector import maybe_inject_fault
+
+            maybe_inject_fault("leaf.drain")
             try:
                 if is_dev:
                     return [device_to_host(db)
@@ -151,15 +154,63 @@ class MultiProcessRunner(DistributedRunner):
                     sem.release_all()
 
         threads = 1
+        deadline_ms = 0
         if ctx is not None and len(my_pids) > 1:
             from ..config import TASK_THREADS
 
             threads = min(ctx.conf.get(TASK_THREADS), len(my_pids))
-        if threads > 1:
-            from concurrent.futures import ThreadPoolExecutor
+        if ctx is not None:
+            from ..config import FAULT_STAGE_TIMEOUT_MS
 
-            with ThreadPoolExecutor(max_workers=threads) as pool:
-                per_pid = list(pool.map(drain, my_pids))
+            deadline_ms = ctx.conf.get(FAULT_STAGE_TIMEOUT_MS)
+        if threads > 1:
+            # the multi-controller drain loop honors ONE aggregate
+            # stage deadline: a wedged decode surfaces TpuStageTimeout
+            # (and the leaf re-executes from lineage) instead of
+            # blocking this controller's collectives forever while its
+            # peers wait.  Daemon threads, not a ThreadPoolExecutor —
+            # futures workers are joined at interpreter exit, so one
+            # abandoned wedged drain would hang process shutdown, the
+            # exact hang the watchdog exists to prevent.
+            import queue as _queue
+            import threading as _threading
+            import time as _time
+
+            box: "_queue.Queue" = _queue.Queue()
+            slots = _threading.Semaphore(threads)
+
+            def worker(p):
+                with slots:
+                    try:
+                        box.put((p, "ok", drain(p)))
+                    except BaseException as e:  # noqa: BLE001
+                        box.put((p, "err", e))
+
+            for p in my_pids:
+                _threading.Thread(target=worker, args=(p,), daemon=True,
+                                  name=f"mp-drain-{p}").start()
+            deadline = (_time.monotonic() + deadline_ms / 1000.0
+                        if deadline_ms > 0 else None)
+            got = {}
+            while len(got) < len(my_pids):
+                tmo = None if deadline is None else \
+                    max(0.0, deadline - _time.monotonic())
+                try:
+                    p, kind, val = box.get(timeout=tmo)
+                except _queue.Empty:
+                    from ..fault.errors import TpuStageTimeout
+                    from ..fault.stats import GLOBAL as _fault_stats
+
+                    _fault_stats.add("numWatchdogTrips", 1)
+                    raise TpuStageTimeout(
+                        f"multiprocess leaf drain exceeded "
+                        f"fault.stageTimeoutMs={deadline_ms}ms "
+                        f"({len(got)}/{len(my_pids)} splits done)",
+                        site="leaf.drain") from None
+                if kind == "err":
+                    raise val
+                got[p] = val
+            per_pid = [got[p] for p in my_pids]
         else:
             per_pid = [drain(p) for p in my_pids]
 
@@ -170,6 +221,12 @@ class MultiProcessRunner(DistributedRunner):
         shards = {s: (HostBatch.concat(bs) if bs
                       else _empty_batch(node.schema))
                   for s, bs in shard_lists.items()}
+        # host round-trip integrity over the owned shards (same CRC32C
+        # stamp/verify contract as the single-controller staging path)
+        order = sorted(shards)
+        staged = self._verify_host_roundtrip(
+            [shards[s] for s in order], ctx)
+        shards = dict(zip(order, staged))
         return self._place_owned(shards, node.schema)
 
     def _place_owned(self, shards, schema) -> DeviceBatch:
@@ -352,5 +409,13 @@ def run_distributed_mp(session, df, mesh) -> HostBatch:
     phys = session.physical_plan(df.plan)
     ctx = ExecContext(session.conf, session)
     axis = mesh.axis_names[0] if mesh.axis_names else _AX
-    return MultiProcessRunner(
-        mesh, transport=make_transport(session.conf, axis)).run(phys, ctx)
+    try:
+        return MultiProcessRunner(
+            mesh,
+            transport=make_transport(session.conf, axis)).run(phys, ctx)
+    finally:
+        from ..fault.stats import GLOBAL as _fault_stats
+
+        session.last_metrics = dict(
+            getattr(session, "last_metrics", None) or {})
+        session.last_metrics.update(_fault_stats.snapshot())

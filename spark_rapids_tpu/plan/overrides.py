@@ -302,6 +302,22 @@ class TpuOverrides:
 
 
 # ==========================================================================
+# Degradation-ladder transition (fault tolerance)
+# ==========================================================================
+def cpu_exec_plan(conf: TpuConf, logical_plan) -> P.PhysicalPlan:
+    """The bottom rung of the graceful-degradation ladder: plan
+    ``logical_plan`` WITHOUT applying any TPU overrides — the pure host
+    physical plan (the reference's transparent CPU fallback, applied to
+    the whole query after device-side fault recovery is exhausted).
+    Bit-identical results are the contract: the host engine is the
+    oracle the TPU plan is tested against."""
+    from .optimizer import optimize
+    from .planner import Planner
+
+    return Planner(conf).plan(optimize(logical_plan))
+
+
+# ==========================================================================
 # Registry population
 # ==========================================================================
 _REGISTRY_DONE = False

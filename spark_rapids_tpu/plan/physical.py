@@ -58,9 +58,16 @@ class ExecContext:
         # oracle session must not disarm a device session's injector)
         if session is not None and \
                 getattr(session, "device_manager", None) is not None:
+            from ..fault.injector import (FaultInjector,
+                                          install_fault_injector)
+            from ..fault.stats import GLOBAL as _fault_stats
             from ..memory.retry import OomInjector, install_injector
 
             install_injector(OomInjector.from_conf(conf))
+            # the generalized fault injector + per-query fault counters
+            # follow the same per-query (re)arm discipline
+            install_fault_injector(FaultInjector.from_conf(conf))
+            _fault_stats.reset()
 
 
 class PartitionedData:

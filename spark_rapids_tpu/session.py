@@ -193,6 +193,26 @@ class Session:
         return phys, ExecContext(self.conf, self)
 
     def execute(self, plan: L.LogicalPlan) -> HostBatch:
+        """Execute with the graceful-degradation ladder: when the
+        native (device) execution exhausts its typed fault recovery —
+        payload corruption past its task retries, a stage crash, a
+        tripped watchdog, a device-semaphore timeout — the query
+        re-executes on the CPU-exec plan (bit-identical by the oracle
+        contract) instead of raising, and ``fault.degradeLevel``
+        records the rung (``fault.degrade.enabled`` gates this)."""
+        from .fault.errors import TpuFaultError
+
+        try:
+            return self._execute_native(plan)
+        except TpuFaultError as e:
+            from .config import FAULT_DEGRADE_ENABLED
+
+            if self.device_manager is None or \
+                    not self.conf.get(FAULT_DEGRADE_ENABLED):
+                raise
+            return self._execute_degraded_cpu(plan, e)
+
+    def _execute_native(self, plan: L.LogicalPlan) -> HostBatch:
         phys, ctx = self.prepare_execution(plan)
         try:
             data = phys.execute(ctx)
@@ -206,8 +226,16 @@ class Session:
             # OOM retry/split counters next to the plan (trace log +
             # last_retry_summary, mirroring the reference's retry
             # metrics in the SQL UI)
+            from .fault.stats import GLOBAL as _fault_stats
+            from .fault.stats import fault_summary
             from .memory.retry import retry_summary
 
+            if self.device_manager is not None:
+                self.last_metrics.update(_fault_stats.snapshot())
+                fsum = fault_summary(self.last_metrics)
+                if fsum:
+                    log.warning(
+                        "query recovered from faults DEGRADED: %s", fsum)
             self.last_retry_summary = retry_summary(self.last_metrics)
             if self.last_retry_summary:
                 from .config import TRACE_ENABLED
@@ -222,6 +250,42 @@ class Session:
             if self.shuffle_catalog is not None:
                 for sid in ctx.shuffle_ids:
                     self.shuffle_catalog.unregister_shuffle(sid)
+
+    def _execute_degraded_cpu(self, plan: L.LogicalPlan,
+                              cause) -> HostBatch:
+        """The bottom ladder rung: re-execute the WHOLE query on the
+        host engine (no TPU overrides), with every injector disarmed —
+        the fallback must run clean.  Fault counters from the failed
+        native attempt are preserved in ``last_metrics`` so the
+        degradation stays visible."""
+        from .fault.injector import install_fault_injector
+        from .fault.stats import DEGRADE_CPU, GLOBAL as _fault_stats
+        from .fault.stats import fault_summary
+        from .memory.retry import install_injector
+        from .plan.overrides import cpu_exec_plan
+
+        install_injector(None)
+        install_fault_injector(None)
+        _fault_stats.set_max("degradeLevel", DEGRADE_CPU)
+        log.warning(
+            "native execution exhausted fault recovery (%s: %s) — "
+            "DEGRADED to the CPU-exec plan",
+            type(cause).__name__, cause)
+        # keep the failed attempt's degradation counters visible
+        prior = {k: v for k, v in (self.last_metrics or {}).items()
+                 if k.startswith(("fault.", "retry."))}
+        phys = cpu_exec_plan(self.conf, plan)
+        ctx = ExecContext(self.conf, None)
+        data = phys.execute(ctx)
+        schema = phys.schema if len(phys.schema) else plan.schema
+        out = collect_batches(data, schema, ctx)
+        self.last_metrics = ctx.metrics.snapshot()
+        self.last_metrics.update(prior)
+        self.last_metrics.update(_fault_stats.snapshot())
+        summary = fault_summary(self.last_metrics)
+        if summary:
+            log.warning("query completed DEGRADED: %s", summary)
+        return out
 
     def execute_columnar(self, plan: L.LogicalPlan):
         """Zero-copy device export: returns the list of DeviceBatches of

@@ -52,7 +52,38 @@ class ShuffleCatalog:
         with self._lock:
             return list(self._shuffles)
 
+    def slot_count(self, shuffle_id: Optional[int] = None) -> int:
+        """Registered buffer slots (one shuffle, or all) — the leak
+        metric the stage-retry regression tests watch."""
+        with self._lock:
+            if shuffle_id is not None:
+                maps = self._shuffles.get(shuffle_id, {})
+                return sum(len(bs) for bs in maps.values())
+            return sum(len(bs) for maps in self._shuffles.values()
+                       for bs in maps.values())
+
     # ----- cleanup ----------------------------------------------------
+    def drop_buffers(self, shuffle_id: int, buf_ids) -> None:
+        """Release SPECIFIC spill entries of one shuffle without
+        unregistering the shuffle id — the cleanup of a failed or
+        re-executed write attempt (stage retry): the retry re-registers
+        a fresh set under the same shuffle id, and without this the
+        dead attempt's ids would hold catalog slots until query end."""
+        drop = set(buf_ids)
+        if not drop:
+            return
+        with self._lock:
+            maps = self._shuffles.get(shuffle_id)
+            if maps is not None:
+                for mid in list(maps):
+                    kept = [b for b in maps[mid] if b not in drop]
+                    if kept:
+                        maps[mid] = kept
+                    else:
+                        del maps[mid]
+        for b in drop:
+            self._fw.remove_batch(b)  # idempotent
+
     def unregister_shuffle(self, shuffle_id: int) -> None:
         """Free every buffer of one shuffle (idempotent)."""
         with self._lock:

@@ -53,6 +53,11 @@ def pytest_configure(config):
         "markers", "oom_injection: drives operators through their "
         "OOM-recovery paths via the deterministic fault injector "
         "(spark.rapids.tpu.memory.oomInjection.*)")
+    config.addinivalue_line(
+        "markers", "fault_injection: drives the distributed "
+        "fault-tolerance layer (corruption/delay/crash recovery, "
+        "watchdogs, degradation ladder) via the generalized "
+        "deterministic injector (spark.rapids.tpu.fault.injection.*)")
 
 
 @pytest.fixture(autouse=True)
@@ -64,14 +69,23 @@ def _hang_watchdog():
 
 @pytest.fixture(autouse=True)
 def _disarm_oom_injector():
-    """An armed fault injector must never outlive its test — a later
-    test's ExecContext normally re-installs from its own conf, but a
-    test that fails before executing a query would otherwise inherit
-    injected OOMs."""
+    """An armed injector (legacy OOM slot OR the generalized fault
+    slot) must never outlive its test — a later test's ExecContext
+    normally re-installs from its own conf, but a test that fails
+    before executing a query would otherwise inherit injected faults.
+    Also asserts no in-flight recovery state (shield/recovering
+    thread-local scopes) leaked across the test boundary."""
     yield
+    from spark_rapids_tpu.fault.injector import (install_fault_injector,
+                                                 recovery_in_flight)
     from spark_rapids_tpu.memory.retry import install_injector
 
+    leaked = recovery_in_flight()
     install_injector(None)
+    install_fault_injector(None)
+    assert not leaked, \
+        "recovery/shield scope leaked across the test boundary — a " \
+        "combinator exited without unwinding its thread-local depth"
 
 
 @pytest.fixture()

@@ -1,0 +1,93 @@
+"""Worker entry for the 2-process fault-tolerance test (NOT pytest).
+
+Each OS process joins the multi-controller job and runs the SAME seeded
+join+agg plan through MultiProcessRunner under fault injection:
+
+* ``crash``     — BOTH controllers arm an identical ``stage_crash``
+  injection at the stage boundary (mode=nth, same skipCount), so the
+  crash and the bounded stage re-execution replay in lockstep on every
+  controller — recovery control flow must stay replicated or the
+  collectives desync.
+* ``straggler`` — ONLY process 1 arms a ``delay`` injection on its leaf
+  drain: the cross-process collectives must absorb the one-sided lag
+  (the slow controller arrives late; nobody times out) with results
+  unchanged.
+
+Run by tests/test_fault_tolerance.py as:
+
+    python tests/mp_fault_worker.py <coordinator> <nprocs> <pid> <fault>
+"""
+import sys
+
+
+def main():
+    coordinator, nprocs, pid, fault = (sys.argv[1], int(sys.argv[2]),
+                                       int(sys.argv[3]), sys.argv[4])
+
+    from spark_rapids_tpu.parallel.multiprocess import (
+        init_multiprocess, run_distributed_mp)
+
+    mesh = init_multiprocess(coordinator, nprocs, pid,
+                             local_cpu_devices=4)
+
+    import numpy as np
+
+    from spark_rapids_tpu import Session
+    from spark_rapids_tpu.plan import functions as F
+
+    conf = {
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+        "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+        "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+    }
+    if fault == "crash":
+        # identical conf on EVERY controller: the injected crash and
+        # its stage retry replay in lockstep
+        conf.update({
+            "spark.rapids.tpu.fault.injection.mode": "nth",
+            "spark.rapids.tpu.fault.injection.type": "stage_crash",
+            "spark.rapids.tpu.fault.injection.site": "stage.run",
+            "spark.rapids.tpu.fault.injection.skipCount": 0,
+        })
+    elif fault == "straggler" and pid == 1:
+        # one-sided lag: only this controller stalls its leaf drain
+        conf.update({
+            "spark.rapids.tpu.fault.injection.mode": "nth",
+            "spark.rapids.tpu.fault.injection.type": "delay",
+            "spark.rapids.tpu.fault.injection.site": "leaf.drain",
+            "spark.rapids.tpu.fault.injection.delayMs": 1500.0,
+        })
+
+    rng = np.random.RandomState(123)
+    orders = {"o_custkey": rng.randint(0, 60, 500),
+              "o_total": (rng.rand(500) * 1000).round(6)}
+    cust = {"c_custkey": np.arange(60),
+            "c_nation": rng.randint(0, 6, 60)}
+
+    def q(sess):
+        o = sess.create_dataframe(dict(orders))
+        c = sess.create_dataframe(dict(cust))
+        j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+        return j.group_by("c_nation").agg(
+            F.sum("o_total").alias("rev"), F.count("o_total").alias("n"))
+
+    sess = Session(conf)
+    got = sorted(run_distributed_mp(sess, q(sess), mesh).to_rows())
+
+    cpu = Session(tpu_enabled=False)
+    want = sorted(q(cpu).collect())
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[2] == w[2], (g, w)
+        assert abs(g[1] - w[1]) < 1e-6 * max(1.0, abs(w[1])), (g, w)
+
+    retries = sess.last_metrics.get("fault.numStageRetries", 0)
+    if fault == "crash":
+        assert retries >= 1, sess.last_metrics
+        print(f"MPF RETRIES pid={pid} n={retries}", flush=True)
+    print(f"MPF RESULT OK pid={pid} fault={fault} rows={len(got)}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
