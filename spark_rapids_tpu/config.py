@@ -132,7 +132,8 @@ def dump_markdown() -> str:
         if e.is_internal:
             continue
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
-    lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC]
+    lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC,
+              "", _OBSERVABILITY_DOC]
     return "\n".join(lines)
 
 
@@ -197,6 +198,34 @@ Recovery is observable: `fault.numStageRetries`,
 `fault.numChecksumFailures`, `fault.numWatchdogTrips` and
 `fault.degradeLevel` land in `Session.last_metrics`, and a degraded
 query logs a DEGRADED summary."""
+
+
+_OBSERVABILITY_DOC = """\
+## Query telemetry
+
+The `telemetry.*` confs (table above) configure the query-scoped
+observability subsystem (`spark_rapids_tpu/telemetry/`,
+docs/observability.md):
+
+* **Hierarchical spans** — query -> stage -> exec -> attempt, with wall
+  time, device-sync time and rows/batches per physical exec, propagated
+  to worker threads via an explicitly captured thread-local binding.
+* **Structured event log** — query begin/end, spill, retry, split,
+  checksum failure, watchdog trip, degrade-rung change, admission
+  verdict and injected faults, in a bounded in-memory ring plus an
+  optional append-only JSONL sink (`telemetry.eventLog.dir`);
+  multi-controller runs ship worker events back to every controller.
+* **EXPLAIN ANALYZE** — `Session.profile_report()` renders the physical
+  plan annotated with per-exec metrics plus a top-N hot-operator
+  summary; `Session.last_profile` / `Session.profiles` keep the last
+  `telemetry.maxQueryProfiles` profiles.
+* **Exporters** — Prometheus-text and JSON snapshots over the query
+  metrics, plus an HBM-watermark timeline sampled from the
+  DeviceManager every `telemetry.sampleHbmMs` milliseconds.
+
+With `telemetry.enabled=false` (the default) every emitter is a no-op
+and the metrics snapshot is byte-identical to the un-instrumented
+engine."""
 
 
 # ==========================================================================
@@ -452,6 +481,34 @@ EXPORT_COLUMNAR_RDD = conf("spark.rapids.tpu.sql.exportColumnarRdd").doc(
 TRACE_ENABLED = conf("spark.rapids.tpu.sql.trace.enabled").doc(
     "Wrap hot-path sections in jax.profiler trace annotations (reference: "
     "NVTX ranges)").boolean_conf(False)
+
+# --- telemetry (telemetry/; reference: the per-exec SQLMetrics surfaced
+# in the SQL UI + the Spark event log / history server) --------------------
+TELEMETRY_ENABLED = conf("spark.rapids.tpu.telemetry.enabled").doc(
+    "Query telemetry: hierarchical spans (query -> stage -> exec -> "
+    "attempt), the structured event log, EXPLAIN-ANALYZE profiles "
+    "(Session.profile_report()) and the metrics exporters "
+    "(telemetry/export.py).  Off by default: every emitter is a no-op "
+    "and the metrics snapshot is unchanged").boolean_conf(False)
+TELEMETRY_EVENT_LOG_DIR = conf(
+    "spark.rapids.tpu.telemetry.eventLog.dir").doc(
+    "Directory for the append-only JSONL event log (one "
+    "events-<queryId>.jsonl per query — the history-server analogue); "
+    "empty keeps events only in the bounded in-memory ring").string_conf("")
+TELEMETRY_MAX_QUERY_PROFILES = conf(
+    "spark.rapids.tpu.telemetry.maxQueryProfiles").doc(
+    "Completed query profiles retained on the Session "
+    "(Session.profiles / Session.last_profile); the oldest profile is "
+    "dropped first").int_conf(8)
+TELEMETRY_SAMPLE_HBM_MS = conf(
+    "spark.rapids.tpu.telemetry.sampleHbmMs").doc(
+    "HBM-watermark sampling period, milliseconds: a per-query sampler "
+    "thread records the DeviceManager's allocated/peak-bytes timeline "
+    "into the profile and exporters (0 disables the sampler)").int_conf(0)
+TELEMETRY_MAX_EVENTS = conf("spark.rapids.tpu.telemetry.maxEvents").doc(
+    "Capacity of the per-query in-memory event ring (oldest events are "
+    "dropped first and counted); the JSONL file sink is append-only "
+    "and unbounded").int_conf(4096)
 
 
 class TpuConf:

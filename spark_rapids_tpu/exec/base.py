@@ -88,6 +88,16 @@ class TpuExec(PhysicalPlan):
             M.PEAK_DEVICE_MEMORY: reg.metric(
                 prefix + M.PEAK_DEVICE_MEMORY, "max"),
         }
+        # telemetry: one exec-kind span per physical exec name, plus the
+        # deviceSyncTime metric the transitions feed — both exist ONLY
+        # while a query telemetry is active, so the disabled snapshot
+        # stays byte-identical to the un-instrumented engine
+        from ..telemetry import spans as tspans
+
+        if tspans.current() is not None:
+            self.metrics[M.DEVICE_SYNC_TIME] = reg.metric(
+                prefix + M.DEVICE_SYNC_TIME, "ns")
+            tspans.register_exec(self)
 
     @property
     def supports_columnar(self) -> bool:

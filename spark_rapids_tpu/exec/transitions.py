@@ -160,13 +160,19 @@ class HostToDeviceExec(TpuExec):
         rctx = R.RetryContext.for_exec(ctx, "HostToDeviceExec")
 
         def upload(hb):
+            import time as _time
+
             if sem:
                 sem.acquire_if_necessary()
             R.maybe_inject_oom("HostToDeviceExec.upload")
+            t0 = _time.perf_counter_ns()
             with trace_range("HostToDevice",
                              self.metrics[M.TOTAL_TIME]):
                 db = host_to_device(hb, min_rows,
                                     string_guard_bytes=str_guard)
+            sync = self.metrics.get(M.DEVICE_SYNC_TIME)
+            if sync is not None:  # registered only under telemetry
+                sync.add(_time.perf_counter_ns() - t0)
             self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
             self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
             return db
@@ -262,6 +268,8 @@ class HostToDeviceExec(TpuExec):
                 import queue
                 import threading
 
+                from ..telemetry import spans as tspans
+
                 q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
                 stop = threading.Event()
                 END = object()
@@ -280,9 +288,13 @@ class HostToDeviceExec(TpuExec):
                     except BaseException as e:  # noqa: BLE001
                         err[0] = e
 
+                # the producer thread inherits no thread-locals: the
+                # telemetry binding is captured here and attached in
+                # the worker (test_lint_telemetry.py enforces this at
+                # every spawn site)
                 t = threading.Thread(
-                    target=produce, daemon=True,
-                    name=f"h2d-prefetch-{pid}")
+                    target=tspans.bound(tspans.capture(), produce),
+                    daemon=True, name=f"h2d-prefetch-{pid}")
                 t.start()
                 try:
                     while True:
@@ -340,10 +352,16 @@ class DeviceToHostExec(TpuExec):
 
         def make(pid):
             def it():
+                import time as _time
+
                 for db in child_data.iterator(pid):
+                    t0 = _time.perf_counter_ns()
                     with trace_range("DeviceToHost",
                                      self.metrics[M.TOTAL_TIME]):
                         hb = device_to_host(db)
+                    sync = self.metrics.get(M.DEVICE_SYNC_TIME)
+                    if sync is not None:  # telemetry-only metric
+                        sync.add(_time.perf_counter_ns() - t0)
                     if sem:
                         sem.release_if_necessary()
                     self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)

@@ -205,6 +205,11 @@ class FaultInjector:
                 fire = self._rng.random() < self.RANDOM_PROBABILITY
             if fire:
                 self._injected += 1
+        if fire:
+            from ..telemetry.events import emit_event
+
+            emit_event("fault_injected", type=self.fault_type,
+                       site=site, mode=self.mode, checkpoint=n)
         return fire
 
     # ------------------------------------------------------------------

@@ -24,6 +24,7 @@ from typing import Iterable, List
 
 import numpy as np
 
+from ..telemetry.events import emit_event
 from .errors import TpuPayloadCorruption
 from .stats import GLOBAL as _stats
 
@@ -60,6 +61,8 @@ def verify_frame(frame: np.ndarray, expected: int, site: str,
     got = checksum_frame(frame)
     if got != expected:
         _stats.add("numChecksumFailures", 1)
+        emit_event("checksum_failure", site=site,
+                   got=f"0x{got:08x}", expected=f"0x{expected:08x}")
         raise TpuPayloadCorruption(
             f"payload checksum mismatch at {site}: "
             f"crc32c=0x{got:08x} expected=0x{expected:08x}"
@@ -102,6 +105,8 @@ def verify_host_batches(batches, stamps: List[int], site: str) -> None:
         got = checksum_host_batch(b)
         if got != expected:
             _stats.add("numChecksumFailures", 1)
+            emit_event("checksum_failure", site=site, batch=i,
+                       got=f"0x{got:08x}", expected=f"0x{expected:08x}")
             raise TpuPayloadCorruption(
                 f"host round-trip checksum mismatch at {site} "
                 f"(batch {i}): crc32c=0x{got:08x} "

@@ -67,6 +67,21 @@ def run_with_fault_tolerance(session, df, mesh=None, n_devices: int = 8):
             merged.get("fault.degradeLevel", 0), DEGRADE_SINGLE_PROCESS)
         _stats.set_max("degradeLevel", merged["fault.degradeLevel"])
         session.last_metrics = merged
+        # the degrade decision must be visible in the profile the user
+        # will actually read: session.execute installed the rung-1
+        # query's telemetry as last_profile, so emit AFTER it (the
+        # event log stays live for late events) and refresh its
+        # metrics with the cross-rung merge
+        from ..config import TELEMETRY_ENABLED
+        from ..telemetry.events import emit_event
+
+        emit_event("degrade", level=DEGRADE_SINGLE_PROCESS,
+                   rung="single-process", cause=type(e).__name__)
+        if getattr(session, "last_profile", None) is not None \
+                and session.conf.get(TELEMETRY_ENABLED):
+            # telemetry was on for the rung-1 execute, so last_profile
+            # is THIS query's — refresh with the cross-rung merge
+            session.last_profile.metrics = dict(merged)
         summary = fault_summary(merged)
         if summary:
             log.warning("query completed DEGRADED: %s", summary)

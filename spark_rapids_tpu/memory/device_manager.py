@@ -136,6 +136,11 @@ class DeviceManager:
             if still_over > 0 and not freed:
                 with self._alloc_lock:
                     self._allocated = max(0, self._allocated - nbytes)
+                from ..telemetry.events import emit_event
+
+                emit_event("admission_reject", requested=nbytes,
+                           over_bytes=still_over,
+                           arena_bytes=self.arena_bytes)
                 raise TpuRetryOOM(
                     f"device arena exhausted: allocation of {nbytes} "
                     f"bytes leaves usage {still_over} bytes over the "

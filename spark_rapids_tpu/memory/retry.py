@@ -224,6 +224,9 @@ class RetryContext:
     def on_split(self) -> None:
         if self.num_split_retries is not None:
             self.num_split_retries.add(1)
+        from ..telemetry.events import emit_event
+
+        emit_event("split", op=self.op_name)
 
     def held_count(self) -> int:
         sem = self.semaphore
@@ -248,8 +251,10 @@ class RetryContext:
         4. back off with bounded exponential delay + seeded jitter;
         5. re-enter device admission for the retry.
         """
+        from ..telemetry.events import emit_event
         from ..utils.tracing import trace_range
 
+        emit_event("retry", op=self.op_name, attempt=attempt)
         start = time.perf_counter()
         with trace_range(f"RetryRecover[{self.op_name}]"), _shield():
             if self.num_retries is not None:

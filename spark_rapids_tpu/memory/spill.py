@@ -33,6 +33,7 @@ import numpy as np
 
 from ..data.column import (DeviceBatch, HostBatch, device_to_host,
                            host_to_device)
+from ..telemetry.events import emit_event
 from .hpq import make_spill_queue
 
 log = logging.getLogger(__name__)
@@ -424,6 +425,8 @@ class SpillFramework:
         self.host_queue.push(buf.id, buf.priority)
         self.metrics["spill_to_host"] += 1
         self.metrics["bytes_spilled"] += buf.size
+        emit_event("spill", tier="host", bytes=buf.size,
+                   buf_id=buf.id)
         for cb in list(self.spill_listeners):
             cb(buf.id)
         return buf.size
@@ -465,6 +468,8 @@ class SpillFramework:
             buf.to_disk(self.spill_dir)
             self.host_bytes -= buf.size
             self.metrics["spill_to_disk"] += 1
+            emit_event("spill", tier="disk", bytes=buf.size,
+                       buf_id=buf.id)
 
 
 class MemoryEventHandler:

@@ -41,6 +41,12 @@ def export_device_batches(session, plan: L.LogicalPlan) -> List[DeviceBatch]:
                 out.append(b)
         return out
     finally:
+        # same query-end contract as Session._finalize_metrics: the
+        # export path owns its ExecContext, so it must finish the
+        # query telemetry (stops the HbmSampler, emits query_end)
+        from ..telemetry import finish_query
+
+        finish_query(session, ctx, phys=root)
         root._exec_lock.release()
 
 

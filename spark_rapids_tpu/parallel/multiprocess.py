@@ -176,6 +176,8 @@ class MultiProcessRunner(DistributedRunner):
             import threading as _threading
             import time as _time
 
+            from ..telemetry import spans as tspans
+
             box: "_queue.Queue" = _queue.Queue()
             slots = _threading.Semaphore(threads)
 
@@ -186,8 +188,12 @@ class MultiProcessRunner(DistributedRunner):
                     except BaseException as e:  # noqa: BLE001
                         box.put((p, "err", e))
 
+            # drain workers inherit no thread-locals: capture the
+            # telemetry binding once, attach per worker
+            cap = tspans.capture()
             for p in my_pids:
-                _threading.Thread(target=worker, args=(p,), daemon=True,
+                _threading.Thread(target=tspans.bound(cap, worker),
+                                  args=(p,), daemon=True,
                                   name=f"mp-drain-{p}").start()
             deadline = (_time.monotonic() + deadline_ms / 1000.0
                         if deadline_ms > 0 else None)
@@ -200,8 +206,11 @@ class MultiProcessRunner(DistributedRunner):
                 except _queue.Empty:
                     from ..fault.errors import TpuStageTimeout
                     from ..fault.stats import GLOBAL as _fault_stats
+                    from ..telemetry.events import emit_event
 
                     _fault_stats.add("numWatchdogTrips", 1)
+                    emit_event("watchdog_trip", site="leaf.drain",
+                               timeout_ms=deadline_ms)
                     raise TpuStageTimeout(
                         f"multiprocess leaf drain exceeded "
                         f"fault.stageTimeoutMs={deadline_ms}ms "
@@ -398,6 +407,24 @@ class MultiProcessRunner(DistributedRunner):
         return HostBatch.concat(host)
 
 
+def _ship_back_events(ctx) -> None:
+    """Telemetry event ship-back: merge every peer controller's events
+    into the local query log (alongside the result gather — the same
+    collective discipline as the stage programs).  Runs ONLY on the
+    success path: after a failed run, peer control flow is not
+    guaranteed to reach the collective."""
+    tele = getattr(ctx, "telemetry", None)
+    if tele is None:
+        return
+    from ..telemetry.events import gather_multiprocess_events
+
+    try:
+        tele.events.extend_shipped(
+            gather_multiprocess_events(tele.events.snapshot()))
+    except Exception:  # noqa: BLE001 — observability must never fail
+        pass          # the query that produced the data
+
+
 def run_distributed_mp(session, df, mesh) -> HostBatch:
     """Execute ``df`` SPMD across every controller process of ``mesh``.
     Must be called by ALL processes with an identically-built plan;
@@ -410,12 +437,17 @@ def run_distributed_mp(session, df, mesh) -> HostBatch:
     ctx = ExecContext(session.conf, session)
     axis = mesh.axis_names[0] if mesh.axis_names else _AX
     try:
-        return MultiProcessRunner(
+        out = MultiProcessRunner(
             mesh,
             transport=make_transport(session.conf, axis)).run(phys, ctx)
+        _ship_back_events(ctx)
+        return out
     finally:
         from ..fault.stats import GLOBAL as _fault_stats
 
         session.last_metrics = dict(
             getattr(session, "last_metrics", None) or {})
         session.last_metrics.update(_fault_stats.snapshot())
+        from ..telemetry import finish_query
+
+        finish_query(session, ctx, phys=phys)

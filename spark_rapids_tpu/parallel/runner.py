@@ -45,6 +45,8 @@ from ..fault.errors import (TpuPayloadCorruption, TpuStageCrash,
 from ..fault.injector import maybe_inject_fault
 from ..fault.stats import GLOBAL as _fault_stats
 from ..memory.semaphore import DeviceSemaphoreTimeout
+from ..telemetry import spans as tspans
+from ..telemetry.events import emit_event
 from ..utils import hashing
 from . import exchange as X
 from .mesh import DATA_AXIS
@@ -162,14 +164,18 @@ class DistributedRunner:
         # a daemon thread, NOT a ThreadPoolExecutor: futures workers
         # are joined at interpreter exit, so one abandoned hung attempt
         # would block shutdown — the exact hang the watchdog exists to
-        # prevent
-        t = _threading.Thread(target=attempt, daemon=True,
-                              name="stage-watchdog")
+        # prevent.  The attempt runs off-thread, so the telemetry
+        # binding is captured here and attached in the worker.
+        t = _threading.Thread(
+            target=tspans.bound(tspans.capture(), attempt),
+            daemon=True, name="stage-watchdog")
         t.start()
         try:
             kind, val = box.get(timeout=timeout_ms / 1000.0)
         except _queue.Empty:
             _fault_stats.add("numWatchdogTrips", 1)
+            emit_event("watchdog_trip", site=what,
+                       timeout_ms=timeout_ms)
             raise TpuStageTimeout(
                 f"{what} exceeded fault.stageTimeoutMs={timeout_ms}ms "
                 "— abandoning the hung attempt and re-executing from "
@@ -198,11 +204,15 @@ class DistributedRunner:
         rng = random.Random(conf.get(RETRY_BACKOFF_SEED))
         for attempt in range(max_retries + 1):
             try:
-                return self._with_watchdog(fn, timeout_ms, what)
+                with tspans.span(f"attempt[{attempt}]", kind="attempt",
+                                 what=what):
+                    return self._with_watchdog(fn, timeout_ms, what)
             except RECOVERABLE_FAULTS as e:
                 if attempt == max_retries:
                     raise
                 _fault_stats.add("numStageRetries", 1)
+                emit_event("stage_retry", site=what, attempt=attempt,
+                           error=type(e).__name__)
                 log.warning("%s failed (%s: %s) — re-executing from "
                             "lineage (attempt %d/%d)", what,
                             type(e).__name__, e, attempt + 1,
@@ -342,8 +352,12 @@ class DistributedRunner:
         if threads > 1:
             from concurrent.futures import ThreadPoolExecutor
 
+            # pool workers inherit no thread-locals: capture the
+            # telemetry binding here, attach per drain task
+            cap = tspans.capture()
             with ThreadPoolExecutor(max_workers=threads) as pool:
-                per_pid = list(pool.map(drain, range(n_parts)))
+                per_pid = list(pool.map(tspans.bound(cap, drain),
+                                        range(n_parts)))
         else:
             per_pid = [drain(p) for p in range(n_parts)]
 
@@ -1001,16 +1015,19 @@ class DistributedRunner:
         # protocol: watchdog deadline, typed-fault retry from lineage,
         # exhaustion escalating to the degradation ladder
         for leaf in leaves:
-            env_stacked[self._env_key(leaf)] = self._recover(
-                lambda leaf=leaf: self._run_leaf(leaf.node, ctx),
-                ctx, f"leaf[{leaf.idx}]")
+            with tspans.span(f"leaf[{leaf.idx}]", kind="stage",
+                             node=leaf.node.name):
+                env_stacked[self._env_key(leaf)] = self._recover(
+                    lambda leaf=leaf: self._run_leaf(leaf.node, ctx),
+                    ctx, f"leaf[{leaf.idx}]")
         caps: Dict = {}
         out = None
         for stage in stages:
-            out = self._recover(
-                lambda stage=stage: self._run_stage(
-                    stage, env_stacked, caps),
-                ctx, f"stage[{stage.sid}]")
+            with tspans.span(f"stage[{stage.sid}]", kind="stage"):
+                out = self._recover(
+                    lambda stage=stage: self._run_stage(
+                        stage, env_stacked, caps),
+                    ctx, f"stage[{stage.sid}]")
             env_stacked[f"stage{stage.sid}"] = out
         return self._collect_output(out, stages)
 
@@ -1059,3 +1076,9 @@ def run_distributed(session, df, mesh=None, n_devices: int = 8
         session.last_metrics = dict(
             getattr(session, "last_metrics", None) or {})
         session.last_metrics.update(_fault_stats.snapshot())
+        from ..telemetry import finish_query
+
+        # profile metrics default to THIS query's ctx snapshot — the
+        # session.last_metrics merge above intentionally carries prior
+        # state for the ladder driver and must not back-fill spans
+        finish_query(session, ctx, phys=phys)

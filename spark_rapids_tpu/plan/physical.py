@@ -52,6 +52,14 @@ class ExecContext:
         #: shuffle ids registered during this query, freed at query end
         #: (reference: per-shuffle cleanup, ShuffleBufferCatalog.scala)
         self.shuffle_ids: List[int] = []
+        #: per-query telemetry (telemetry.enabled) — bound to the
+        #: creating thread; worker spawn sites capture() the binding.
+        #: None when disabled (begin() also clears any stale binding)
+        self.telemetry = None
+        if session is not None:
+            from ..telemetry.spans import QueryTelemetry
+
+            self.telemetry = QueryTelemetry.begin(conf, session)
         # (re)arm the OOM fault injector from this query's conf — per
         # query so an oomInjection.skipCount sweep restarts its
         # checkpoint counter every run (device sessions only; a host
@@ -170,6 +178,8 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
     else:
         from concurrent.futures import ThreadPoolExecutor
 
+        from ..telemetry import spans as tspans
+
         def run_task(pid: int):
             try:
                 return drain_with_retry(pid)
@@ -177,8 +187,12 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
                 if sem is not None:
                     sem.release_task()
 
+        # pool workers inherit no thread-locals: capture the telemetry
+        # binding here, attach per task
+        cap = tspans.capture()
         with ThreadPoolExecutor(max_workers=threads) as pool:
-            per_pid = list(pool.map(run_task, range(n)))
+            per_pid = list(pool.map(tspans.bound(cap, run_task),
+                                    range(n)))
         batches = [b for bs in per_pid for b in bs]
     if not batches:
         return _empty_batch(schema)

@@ -57,6 +57,16 @@ class Session:
         #: ("" when the query saw no memory pressure) — EXPLAIN/trace
         #: surface for degraded queries
         self.last_retry_summary: str = ""
+        #: telemetry.QueryProfile of the most recent execution (None
+        #: unless telemetry.enabled); Session.profiles keeps the last
+        #: telemetry.maxQueryProfiles of them
+        self.last_profile = None
+        from collections import deque as _deque
+
+        from .config import TELEMETRY_MAX_QUERY_PROFILES
+
+        self._profiles = _deque(
+            maxlen=max(1, self.conf.get(TELEMETRY_MAX_QUERY_PROFILES)))
         # logical-plan -> physical-plan cache: repeated collect() of the
         # same DataFrame reuses the exec instances and with them every
         # per-exec jit cache (without this, each collect re-traced and
@@ -212,6 +222,46 @@ class Session:
                 raise
             return self._execute_degraded_cpu(plan, e)
 
+    def _finalize_metrics(self, ctx, phys=None,
+                          preserve: Optional[Dict] = None) -> None:
+        """The ONE place the per-query metric snapshot, the fault/retry
+        counters and the telemetry profile are merged into the session
+        at query end (previously duplicated — with hand-copied prefix
+        filters — between ``_execute_native`` and the CPU-fallback
+        path, where drift silently double- or under-counted).
+
+        ``preserve``: already-merged counters from a FAILED earlier
+        attempt (the degraded path) that must stay visible next to the
+        fresh snapshot.  Counters are never double-counted across
+        consecutive queries: the snapshot always starts from this
+        query's own registry, and the process-global fault stats are
+        reset at every query start by ``ExecContext``."""
+        from .fault.stats import GLOBAL as _fault_stats
+        from .fault.stats import fault_summary
+        from .memory.retry import retry_summary
+
+        merged = ctx.metrics.snapshot()
+        if preserve:
+            merged.update(preserve)
+        if self.device_manager is not None:
+            merged.update(_fault_stats.snapshot())
+            fsum = fault_summary(merged)
+            if fsum:
+                log.warning(
+                    "query recovered from faults DEGRADED: %s", fsum)
+        self.last_metrics = merged
+        self.last_retry_summary = retry_summary(merged)
+        if self.last_retry_summary:
+            from .config import TRACE_ENABLED
+
+            lvl = logging.WARNING if self.conf.get(TRACE_ENABLED) \
+                else logging.INFO
+            log.log(lvl, "query completed DEGRADED under memory "
+                    "pressure: %s", self.last_retry_summary)
+        from .telemetry import finish_query
+
+        finish_query(self, ctx, phys=phys, metrics=merged)
+
     def _execute_native(self, plan: L.LogicalPlan) -> HostBatch:
         phys, ctx = self.prepare_execution(plan)
         try:
@@ -220,30 +270,11 @@ class Session:
             return collect_batches(data, schema, ctx)
         finally:
             # benchmark/debug hook: per-exec metric snapshot of the most
-            # recent execution (upload/readback wall decomposition)
-            self.last_metrics = ctx.metrics.snapshot()
-            # a degraded query must be VISIBLY degraded: surface the
-            # OOM retry/split counters next to the plan (trace log +
-            # last_retry_summary, mirroring the reference's retry
+            # recent execution (upload/readback wall decomposition); a
+            # degraded query must be VISIBLY degraded (retry/fault
+            # counters + summaries, mirroring the reference's retry
             # metrics in the SQL UI)
-            from .fault.stats import GLOBAL as _fault_stats
-            from .fault.stats import fault_summary
-            from .memory.retry import retry_summary
-
-            if self.device_manager is not None:
-                self.last_metrics.update(_fault_stats.snapshot())
-                fsum = fault_summary(self.last_metrics)
-                if fsum:
-                    log.warning(
-                        "query recovered from faults DEGRADED: %s", fsum)
-            self.last_retry_summary = retry_summary(self.last_metrics)
-            if self.last_retry_summary:
-                from .config import TRACE_ENABLED
-
-                lvl = logging.WARNING if self.conf.get(TRACE_ENABLED) \
-                    else logging.INFO
-                log.log(lvl, "query completed DEGRADED under memory "
-                        "pressure: %s", self.last_retry_summary)
+            self._finalize_metrics(ctx, phys=phys)
             phys._exec_lock.release()
             # per-shuffle cleanup at query end — frees shuffle output
             # even when a reader abandoned early (limit over a join)
@@ -260,13 +291,15 @@ class Session:
         degradation stays visible."""
         from .fault.injector import install_fault_injector
         from .fault.stats import DEGRADE_CPU, GLOBAL as _fault_stats
-        from .fault.stats import fault_summary
         from .memory.retry import install_injector
         from .plan.overrides import cpu_exec_plan
+        from .telemetry.events import emit_event
 
         install_injector(None)
         install_fault_injector(None)
         _fault_stats.set_max("degradeLevel", DEGRADE_CPU)
+        emit_event("degrade", level=DEGRADE_CPU, rung="cpu",
+                   cause=type(cause).__name__)
         log.warning(
             "native execution exhausted fault recovery (%s: %s) — "
             "DEGRADED to the CPU-exec plan",
@@ -279,12 +312,16 @@ class Session:
         data = phys.execute(ctx)
         schema = phys.schema if len(phys.schema) else plan.schema
         out = collect_batches(data, schema, ctx)
-        self.last_metrics = ctx.metrics.snapshot()
-        self.last_metrics.update(prior)
-        self.last_metrics.update(_fault_stats.snapshot())
-        summary = fault_summary(self.last_metrics)
-        if summary:
-            log.warning("query completed DEGRADED: %s", summary)
+        self._finalize_metrics(ctx, phys=phys, preserve=prior)
+        from .config import TELEMETRY_ENABLED
+
+        if self.last_profile is not None \
+                and self.conf.get(TELEMETRY_ENABLED):
+            # telemetry was on for THIS query, so last_profile is the
+            # native attempt's: refresh it with the final merged
+            # counters (degrade event included).  Without the conf
+            # guard a stale prior-query profile would be corrupted.
+            self.last_profile.metrics = dict(self.last_metrics)
         return out
 
     def execute_columnar(self, plan: L.LogicalPlan):
@@ -306,6 +343,22 @@ class Session:
 
         return TpuOverrides(self.conf.set(
             "spark.rapids.tpu.sql.explain", mode)).explain(phys)
+
+    # ----- telemetry surface ------------------------------------------------
+    @property
+    def profiles(self):
+        """Completed query profiles, newest last (bounded by
+        ``telemetry.maxQueryProfiles``)."""
+        return list(self._profiles)
+
+    def profile_report(self, top_n: int = 5) -> str:
+        """EXPLAIN-ANALYZE report of the most recent execution: the
+        physical plan annotated with per-exec metrics, the span tree, a
+        top-N hot-operator summary and the event digest.  Empty string
+        unless ``telemetry.enabled`` was on for the query."""
+        if self.last_profile is None:
+            return ""
+        return self.last_profile.render(top_n=top_n)
 
     # ----- test hooks (reference: ExecutionPlanCaptureCallback) ------------
     def start_capture(self):

@@ -1,0 +1,65 @@
+"""Query-scoped observability: hierarchical spans, the structured
+event log, EXPLAIN-ANALYZE profiles and metrics exporters.
+
+See docs/observability.md for the span model, event schema and
+exporter formats.  Everything is gated by the ``telemetry.*`` confs
+(config.py) so the disabled path stays near-zero-cost: one
+thread-local ``getattr`` per emitter call.
+
+Public surface:
+
+* :func:`~.events.emit_event` — the exception-safe event emitter every
+  call site outside this package must use;
+* :mod:`~.spans` — ``capture()`` / ``attached()`` / ``bound()`` for
+  worker-thread context propagation, ``span()`` for scoped spans;
+* :func:`~.profile.explain_analyze` and
+  :class:`~.profile.QueryProfile` — the EXPLAIN-ANALYZE surface
+  (``Session.profile_report()``);
+* :mod:`~.export` — Prometheus-text / JSON exporters and the
+  HBM-watermark sampler.
+"""
+from __future__ import annotations
+
+from .events import (EventLog, emit_event, read_event_log,  # noqa: F401
+                     replay_summary)
+from .export import json_snapshot, prometheus_text  # noqa: F401
+from .profile import QueryProfile, explain_analyze  # noqa: F401
+from .spans import QueryTelemetry, Span  # noqa: F401
+
+
+def finish_query(session, ctx, phys=None, metrics=None):
+    """The ONE finish path every execution driver calls at query end
+    (Session._finalize_metrics, run_distributed, run_distributed_mp):
+    finishes ``ctx``'s QueryTelemetry — if any, exactly once — into
+    ``session.last_profile`` / ``session.profiles`` and returns the
+    profile.
+
+    ``metrics``: the final merged snapshot for exec-span back-fill;
+    defaults to THIS query's ``ctx.metrics.snapshot()`` plus the
+    per-query fault counters (never a previous query's
+    ``session.last_metrics``)."""
+    tele = getattr(ctx, "telemetry", None)
+    if tele is None:
+        # a telemetry-disabled query must not leave a stale "most
+        # recent execution" profile behind (history stays available in
+        # session.profiles); the CPU-degraded rung's inner context
+        # keeps conf-enabled, so the native attempt's profile survives
+        from ..config import TELEMETRY_ENABLED
+
+        conf = getattr(ctx, "conf", None)
+        if session is not None and conf is not None \
+                and not conf.get(TELEMETRY_ENABLED):
+            session.last_profile = None
+        return None
+    if tele.finished:
+        return None
+    if metrics is None:
+        from ..fault.stats import GLOBAL as _fault_stats
+
+        metrics = dict(ctx.metrics.snapshot())
+        metrics.update(_fault_stats.snapshot())
+    profile = tele.finish(metrics=metrics, plan=phys)
+    if profile is not None:
+        session.last_profile = profile
+        session._profiles.append(profile)
+    return profile

@@ -1,0 +1,216 @@
+"""Structured query event log — bounded in-memory ring + JSONL sink.
+
+Reference analogue: the Spark event log consumed by the history server
+(and the SQL-UI accumulator updates the reference plugin rides).  Every
+noteworthy engine transition emits one flat JSON record:
+
+``query_begin`` / ``query_end`` — query lifecycle,
+``spill``            — a buffer demoted device->host or host->disk,
+``retry`` / ``split``— OOM recovery (memory/retry.py),
+``checksum_failure`` — CRC32C mismatch on a spill/exchange read,
+``watchdog_trip``    — a stage/leaf/drain deadline fired,
+``stage_retry``      — a stage/leaf re-executed from lineage,
+``degrade``          — the degradation ladder changed rungs,
+``admission_reject`` — the device arena refused an allocation,
+``fault_injected``   — the deterministic injector fired (test mode).
+
+Emission contract: call sites OUTSIDE ``telemetry/`` must only use
+:func:`emit_event`, which is exception-safe (never raises, never
+blocks recovery) and a no-op when no query telemetry is active —
+``tests/test_lint_telemetry.py`` enforces this at the AST level.
+
+Multi-controller runs ship events back alongside the result gather:
+:func:`gather_multiprocess_events` allgathers every controller's local
+ring (length-agreed, padded) and returns the peer events tagged with
+their source process index.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import spans
+
+
+class EventLog:
+    """Per-query append-only event log: a bounded ring (oldest dropped
+    first, drops counted) plus an optional JSONL file sink under
+    ``telemetry.eventLog.dir`` (one ``events-<queryId>.jsonl`` per
+    query — the history-server analogue)."""
+
+    def __init__(self, query_id: str, max_events: int = 4096,
+                 sink_dir: str = ""):
+        self.query_id = query_id
+        self._ring: deque = deque(maxlen=max_events)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self.sink_path: Optional[str] = None
+        self._sink = None  # opened lazily at first emit
+        if sink_dir:
+            # a bad/unwritable eventLog.dir degrades to the in-memory
+            # ring — observability must never fail the query it watches
+            try:
+                os.makedirs(sink_dir, exist_ok=True)
+                self.sink_path = os.path.join(
+                    sink_dir, f"events-{query_id}.jsonl")
+            except OSError:
+                self.sink_path = None
+
+    # ------------------------------------------------------------------
+    def _append(self, rec: Dict, to_sink: bool = True) -> None:
+        """The ONE ring-append + drop-accounting (+ sink) path — local
+        emit and peer ship-back share it, so the bookkeeping can never
+        diverge.  The sink write happens under the same lock so lines
+        from concurrent worker threads never interleave."""
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(rec)
+            if to_sink and self.sink_path is not None:
+                self._write_sink_locked(rec)
+
+    def _write_sink_locked(self, rec: Dict) -> None:
+        # one handle per log, flushed per line: same torn-tail crash
+        # guarantee (read_event_log tolerates a torn last line) at one
+        # write syscall per event instead of open/write/close on the
+        # recovery hot path.  default=str keeps the file in agreement
+        # with the ring when emitters pass numpy scalars etc.
+        try:
+            if self._sink is None:
+                self._sink = open(self.sink_path, "a")
+            self._sink.write(json.dumps(rec, sort_keys=True,
+                                        default=str) + "\n")
+            self._sink.flush()
+        except (OSError, TypeError, ValueError):
+            self.sink_path = None  # sink degrades; ring keeps the data
+            self._sink = None
+
+    def emit(self, etype: str, **fields) -> Dict:
+        """Append one event (ring + sink).  Internal API — external
+        call sites go through :func:`emit_event`."""
+        rec = {"ts": time.time(), "event": etype,
+               "query": self.query_id}
+        rec.update(fields)
+        self._append(rec)
+        return rec
+
+    def extend_shipped(self, events: List[Dict]) -> None:
+        """Merge events shipped back from peer controllers (already
+        tagged with their source ``proc``); shipped events are ring-
+        only (the peer's own sink already persisted them)."""
+        for rec in events:
+            self._append(rec, to_sink=False)
+
+    def snapshot(self) -> List[Dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+# ==========================================================================
+# The exception-safe emitter — the ONLY entry point for call sites
+# outside telemetry/
+# ==========================================================================
+def emit_event(etype: str, **fields) -> None:
+    """Emit one event into the active query's log.  Never raises and
+    never blocks: a telemetry failure must not break recovery paths
+    (most emitters sit INSIDE exception handlers).  No-op when no
+    query telemetry is active."""
+    try:
+        tele = spans.current()
+        if tele is None or tele.events is None:
+            return
+        tele.events.emit(etype, **fields)
+    except Exception:  # noqa: BLE001 — observability must never throw
+        pass
+
+
+# ==========================================================================
+# Round-trip helpers (the history-server read side)
+# ==========================================================================
+def read_event_log(path: str) -> List[Dict]:
+    """Parse one JSONL event-log file back into records (tolerates a
+    torn trailing line — the process may have died mid-append)."""
+    out: List[Dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                break  # torn tail: keep the parseable prefix
+    return out
+
+
+def replay_summary(events: List[Dict]) -> Dict:
+    """Aggregate a parsed event stream the way a history server would:
+    per-type counts, the queries seen, and the wall span covered."""
+    counts: Dict[str, int] = {}
+    queries = set()
+    ts = [e["ts"] for e in events if "ts" in e]
+    for e in events:
+        counts[e.get("event", "?")] = counts.get(e.get("event", "?"), 0) + 1
+        if e.get("query"):
+            queries.add(e["query"])
+    return {
+        "num_events": len(events),
+        "counts": counts,
+        "queries": sorted(queries),
+        "first_ts": min(ts) if ts else None,
+        "last_ts": max(ts) if ts else None,
+    }
+
+
+# ==========================================================================
+# Multi-controller ship-back
+# ==========================================================================
+def gather_multiprocess_events(local_events: List[Dict]) -> List[Dict]:
+    """Allgather every controller's local events and return the PEER
+    events tagged with their source process index (``proc``).  Must be
+    called collectively (same control flow on every controller — the
+    same contract as the stage programs); lengths are agreed through a
+    small allgather first, payloads padded to the maximum."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    import jax
+
+    nprocs = jax.process_count()
+    if nprocs <= 1:
+        return []  # no peers to ship from
+    payload = np.frombuffer(
+        json.dumps(local_events).encode("utf-8"), dtype=np.uint8)
+    sizes = multihost_utils.process_allgather(
+        np.asarray([payload.size], dtype=np.int64))
+    maxlen = max(int(np.asarray(sizes).max()), 1)
+    padded = np.zeros(maxlen, dtype=np.uint8)
+    padded[:payload.size] = payload
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded)).reshape(
+            nprocs, maxlen)
+    me = jax.process_index()
+    out: List[Dict] = []
+    sizes = np.asarray(sizes).reshape(-1)
+    for proc in range(gathered.shape[0]):
+        if proc == me:
+            continue
+        nbytes = int(sizes[proc])
+        if not nbytes:
+            continue
+        try:
+            recs = json.loads(bytes(gathered[proc, :nbytes]))
+        except ValueError:
+            continue
+        for rec in recs:
+            rec = dict(rec)
+            rec["proc"] = proc
+            out.append(rec)
+    return out

@@ -1,0 +1,138 @@
+"""Metrics export: Prometheus text exposition + JSON snapshots + the
+HBM-watermark sampler.
+
+Reference analogue: the reference plugin surfaces SQLMetrics through
+the Spark UI/REST API; a standalone engine needs its own scrape
+surface.  Output is deterministic (sorted keys) so repeated exports of
+the same snapshot are byte-identical — exporter stability is what lets
+a scraper diff two snapshots.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: metric-name prefix of every exported sample
+PROM_PREFIX = "spark_rapids_tpu"
+
+
+def _sanitize(name: str) -> str:
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _split_metric(key: str) -> Tuple[str, str]:
+    """``ExecName.metric`` -> (exec, metric); counter families
+    (``retry.numRetries``) and bare keys export with an empty exec."""
+    if "." in key:
+        head, tail = key.split(".", 1)
+        if head and head[0].isupper():
+            return head, tail
+    return "", key
+
+
+def prometheus_text(metrics: Dict[str, int],
+                    query_id: Optional[str] = None,
+                    hbm_timeline: Optional[List] = None) -> str:
+    """Render a metric snapshot in the Prometheus text exposition
+    format (one gauge family, labeled by exec/metric; stable order)."""
+    family = f"{PROM_PREFIX}_metric"
+    lines = [f"# HELP {family} spark-rapids-tpu query metric snapshot",
+             f"# TYPE {family} gauge"]
+    qlabel = f',query="{query_id}"' if query_id else ""
+    for key in sorted(metrics):
+        val = metrics[key]
+        if not isinstance(val, (int, float)):
+            continue
+        exec_name, metric = _split_metric(key)
+        labels = (f'exec="{_sanitize(exec_name)}",'
+                  if exec_name else 'exec="",')
+        lines.append(
+            f"{family}{{{labels}name=\"{_sanitize(metric)}\"{qlabel}}}"
+            f" {val}")
+    if hbm_timeline:
+        # column 2 is the DeviceManager's tracked high-watermark — it
+        # catches spikes that rise and free BETWEEN samples, which the
+        # allocated column (1) misses
+        peak = max(t[2] for t in hbm_timeline)
+        hbm = f"{PROM_PREFIX}_hbm_watermark_bytes"
+        lines.append(f"# HELP {hbm} peak sampled device-arena bytes")
+        lines.append(f"# TYPE {hbm} gauge")
+        lines.append(f"{hbm}{{{qlabel[1:] if qlabel else ''}}} {peak}"
+                     if qlabel else f"{hbm} {peak}")
+    return "\n".join(lines) + "\n"
+
+
+def json_snapshot(metrics: Dict[str, int],
+                  query_id: Optional[str] = None,
+                  events: Optional[List[Dict]] = None,
+                  hbm_timeline: Optional[List] = None) -> str:
+    """One JSON document of the same snapshot (stable key order)."""
+    doc = {
+        "query": query_id,
+        "metrics": {k: metrics[k] for k in sorted(metrics)},
+    }
+    if events is not None:
+        from .events import replay_summary
+
+        doc["events"] = replay_summary(events)
+    if hbm_timeline is not None:
+        doc["hbm_timeline"] = [list(t) for t in hbm_timeline]
+    return json.dumps(doc, sort_keys=True, indent=1)
+
+
+class HbmSampler:
+    """Samples the DeviceManager's logical-arena usage on a daemon
+    thread every ``telemetry.sampleHbmMs`` ms into a bounded timeline
+    of ``(ts, allocated_bytes, peak_bytes)`` — the HBM-watermark trace
+    the profile and exporters surface."""
+
+    MAX_SAMPLES = 4096
+
+    def __init__(self, device_manager, interval_ms: int):
+        self._dm = device_manager
+        self._interval_s = max(1, int(interval_ms)) / 1000.0
+        self._samples: List[Tuple[float, int, int]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _sample_once(self) -> None:
+        rec = (time.time(), self._dm.allocated_bytes, self._dm.peak_bytes)
+        with self._lock:
+            if len(self._samples) < self.MAX_SAMPLES:
+                self._samples.append(rec)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            self._sample_once()
+
+    def start(self) -> None:
+        from . import spans as _spans
+
+        if self._thread is not None:
+            return
+        self._sample_once()  # t0 sample even for very short queries
+        cap = _spans.capture()
+        self._thread = threading.Thread(
+            target=_spans.bound(cap, self._loop), daemon=True,
+            name="hbm-sampler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._sample_once()  # closing sample
+
+    def timeline(self) -> List[Tuple[float, int, int]]:
+        with self._lock:
+            return list(self._samples)

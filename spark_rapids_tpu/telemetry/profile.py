@@ -1,0 +1,167 @@
+"""EXPLAIN-ANALYZE profiles: the physical plan annotated with per-exec
+metrics, the span tree, a hot-operator summary and the event digest.
+
+Reference analogue: the per-exec SQLMetrics panel of the Spark SQL UI
+(GpuExec's standard metric set rendered on the plan graph) plus the
+"Rethinking Analytical Processing in the GPU Era" argument that
+data-movement-aware profiles must precede any perf work — upload,
+readback and device-sync wall are first-class columns here.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+#: metric suffixes excluded from the "is this exec interesting" test
+_STD = ("numOutputRows", "numOutputBatches", "totalTime",
+        "deviceSyncTime")
+
+
+def _fmt_ms(ns) -> str:
+    return f"{ns / 1e6:.2f}ms"
+
+
+def _exec_prefixes(metrics: Dict[str, int]) -> Dict[str, Dict[str, int]]:
+    """Group a flat metric snapshot by its ``<ExecName>.`` prefixes
+    (counter families like ``retry.``/``fault.`` are not execs)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for key, val in metrics.items():
+        if "." not in key:
+            continue
+        name, metric = key.split(".", 1)
+        if not name or not name[0].isupper():
+            continue  # retry./fault./telemetry. counter families
+        out.setdefault(name, {})[metric] = val
+    return out
+
+
+def explain_analyze(plan, metrics: Dict[str, int]) -> str:
+    """Render ``plan``'s tree annotated with each exec's measured
+    metrics (wall, device-sync, rows, batches) — the EXPLAIN ANALYZE
+    surface.  Execs that never initialized metrics annotate empty."""
+    per_exec = _exec_prefixes(metrics)
+
+    def annotate(node) -> str:
+        m = per_exec.get(node.name)
+        if not m:
+            return ""
+        parts = []
+        if "totalTime" in m:
+            parts.append(f"wall={_fmt_ms(m['totalTime'])}")
+        if m.get("deviceSyncTime"):
+            parts.append(f"sync={_fmt_ms(m['deviceSyncTime'])}")
+        if "numOutputRows" in m:
+            parts.append(f"rows={m['numOutputRows']}")
+        if "numOutputBatches" in m:
+            parts.append(f"batches={m['numOutputBatches']}")
+        extras = {k: v for k, v in m.items() if k not in _STD and v}
+        for k in sorted(extras)[:3]:
+            parts.append(f"{k}={extras[k]}")
+        return "[" + " ".join(parts) + "] " if parts else ""
+
+    return plan.tree_string(annotate=annotate)
+
+
+def hot_operators(metrics: Dict[str, int],
+                  top_n: int = 5) -> List[Tuple[str, int, int]]:
+    """Top-N execs by measured wall: (name, wall_ns, rows)."""
+    per_exec = _exec_prefixes(metrics)
+    ranked = sorted(
+        ((name, m.get("totalTime", 0), m.get("numOutputRows", 0))
+         for name, m in per_exec.items()),
+        key=lambda t: t[1], reverse=True)
+    return [r for r in ranked if r[1] > 0][:top_n]
+
+
+class QueryProfile:
+    """The finished profile of one query: span tree, event log (a LIVE
+    reference — late events like a degrade decision taken above the
+    finalize layer still appear), metric snapshot, plan, HBM timeline."""
+
+    def __init__(self, tele, metrics: Dict[str, int],
+                 plan=None):
+        self.query_id = tele.query_id
+        self.root = tele.root
+        self.events = tele.events
+        self.metrics = dict(metrics)
+        self.plan = plan
+        self.hbm_timeline = list(tele.hbm_timeline)
+
+    # ------------------------------------------------------------------
+    @property
+    def wall_ns(self) -> int:
+        return self.root.wall_ns
+
+    def span_tree(self) -> Dict:
+        """Nested plain-dict form of the span tree."""
+        return self.root.to_dict()
+
+    def exec_spans(self) -> Dict[str, Dict]:
+        """Flat exec-name -> span-dict view (test/assertion surface)."""
+        out = {}
+
+        def walk(sp):
+            if sp["kind"] == "exec":
+                out[sp["name"]] = sp
+            for c in sp["children"]:
+                walk(c)
+
+        walk(self.span_tree())
+        return out
+
+    # ------------------------------------------------------------------
+    def _render_span(self, sp: Dict, indent: int,
+                     lines: List[str]) -> None:
+        pad = "  " * indent
+        parts = [f"{pad}{sp['kind']}:{sp['name']}",
+                 f"wall={_fmt_ms(sp['wall_ns'])}"]
+        if sp["device_sync_ns"]:
+            parts.append(f"sync={_fmt_ms(sp['device_sync_ns'])}")
+        if sp["rows"]:
+            parts.append(f"rows={sp['rows']}")
+        if sp["batches"]:
+            parts.append(f"batches={sp['batches']}")
+        if sp["attrs"]:
+            parts.append(str(sp["attrs"]))
+        lines.append(" ".join(parts))
+        for c in sp["children"]:
+            self._render_span(c, indent + 1, lines)
+
+    def render(self, top_n: int = 5) -> str:
+        """The full EXPLAIN-ANALYZE report."""
+        lines = [f"== Query profile {self.query_id} "
+                 f"(wall={_fmt_ms(self.wall_ns)}) =="]
+        if self.plan is not None:
+            lines.append("")
+            lines.append("-- Physical plan (annotated) --")
+            lines.append(explain_analyze(self.plan, self.metrics))
+        hot = hot_operators(self.metrics, top_n)
+        if hot:
+            lines.append("")
+            lines.append(f"-- Top {len(hot)} operators by wall --")
+            for name, wall, rows in hot:
+                lines.append(f"  {name}: {_fmt_ms(wall)} "
+                             f"(rows={rows})")
+        lines.append("")
+        lines.append("-- Span tree --")
+        self._render_span(self.span_tree(), 0, lines)
+        from .events import replay_summary
+
+        summary = replay_summary(self.events.snapshot())
+        lines.append("")
+        lines.append(f"-- Events ({summary['num_events']}"
+                     + (f", {self.events.dropped} dropped"
+                        if self.events.dropped else "") + ") --")
+        for etype in sorted(summary["counts"]):
+            lines.append(f"  {etype}: {summary['counts'][etype]}")
+        if self.hbm_timeline:
+            # (ts, allocated, peak): the peak column catches spikes
+            # freed between samples
+            peak = max(t[2] for t in self.hbm_timeline)
+            lines.append("")
+            lines.append(f"-- HBM watermark ({len(self.hbm_timeline)} "
+                         f"samples, peak={peak}B) --")
+        return "\n".join(lines)
+
+    def __repr__(self):  # pragma: no cover
+        return (f"QueryProfile({self.query_id}, "
+                f"wall={_fmt_ms(self.wall_ns)})")

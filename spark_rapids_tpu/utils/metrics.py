@@ -41,6 +41,10 @@ NUM_INPUT_ROWS = "numInputRows"
 NUM_INPUT_BATCHES = "numInputBatches"
 TOTAL_TIME = "totalTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
+#: host wall spent blocked on device transfer/sync (transitions) —
+#: registered per exec only while telemetry is enabled, so the default
+#: metrics snapshot stays byte-identical to the un-instrumented engine
+DEVICE_SYNC_TIME = "deviceSyncTime"
 
 # OOM retry framework (memory/retry.py; registered as "retry.<name>")
 NUM_RETRIES = "numRetries"

@@ -4,7 +4,12 @@ Reference analogue: NVTX ranges on the hot path (NvtxRange /
 NvtxWithMetrics couple a range with a SQLMetric nanosecond accumulator, see
 SURVEY §5).  TPU equivalent: ``jax.profiler.TraceAnnotation`` so ranges show
 in xprof, with the same metric coupling so wall time lands in the engine's
-metrics too."""
+metrics too.
+
+``trace_range`` is ONE exception-safe path: the optional profiler
+annotation, the optional metric coupling, and the telemetry span-stack
+push/pop (re-entrant, thread-local — a re-entered range name never
+double counts) all ride the same try/finally, enabled or not."""
 from __future__ import annotations
 
 import time
@@ -12,32 +17,47 @@ from contextlib import contextmanager
 
 _ENABLED = False
 
+_spans = None  # telemetry.spans module, bound at first use
+
 
 def enable(flag: bool = True) -> None:
     global _ENABLED
     _ENABLED = flag
 
 
+def _telemetry_spans():
+    global _spans
+    if _spans is None:
+        from ..telemetry import spans as _mod
+
+        _spans = _mod
+    return _spans
+
+
 @contextmanager
 def trace_range(name: str, metric=None):
     """A named profiler range; if ``metric`` is given, elapsed nanoseconds
-    are added to it (reference: NvtxWithMetrics.scala:44)."""
+    are added to it (reference: NvtxWithMetrics.scala:44).  The range is
+    also pushed on the active telemetry span stack, so its wall
+    aggregates under the current span (no-op when telemetry is off)."""
+    spans = _telemetry_spans()
     start = time.perf_counter_ns()
+    annotation = None
     if _ENABLED:
         import jax.profiler
 
-        with jax.profiler.TraceAnnotation(name):
-            try:
-                yield
-            finally:
-                if metric is not None:
-                    metric.add(time.perf_counter_ns() - start)
-    else:
-        try:
-            yield
-        finally:
-            if metric is not None:
-                metric.add(time.perf_counter_ns() - start)
+        annotation = jax.profiler.TraceAnnotation(name)
+        annotation.__enter__()
+    token = spans.push_range(name)
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter_ns() - start
+        spans.pop_range(token, elapsed)
+        if annotation is not None:
+            annotation.__exit__(None, None, None)
+        if metric is not None:
+            metric.add(elapsed)
 
 
 class DebugRange:

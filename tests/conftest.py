@@ -88,6 +88,17 @@ def _disarm_oom_injector():
         "combinator exited without unwinding its thread-local depth"
 
 
+@pytest.fixture(autouse=True)
+def _clear_telemetry_binding():
+    """A query-telemetry binding (thread-local) must never outlive its
+    test: a finished query's ring would silently collect the next
+    test's late events."""
+    yield
+    from spark_rapids_tpu.telemetry import spans
+
+    spans.deactivate()
+
+
 @pytest.fixture()
 def cpu_session():
     from spark_rapids_tpu import Session

@@ -1,0 +1,67 @@
+"""Worker entry for the 2-process telemetry ship-back test (NOT a
+pytest file).
+
+Each OS process joins the multi-controller job, runs the SAME seeded
+shuffled join+agg with ``telemetry.enabled``, and asserts that after
+the run its local event log ALSO contains events shipped back from the
+peer controller (tagged with their source ``proc``) — the
+history-server analogue of executors shipping task events to the
+driver.  Run by tests/test_telemetry.py as:
+
+    python tests/mp_telemetry_worker.py <coordinator> <nprocs> <pid>
+"""
+import sys
+
+
+def main():
+    coordinator, nprocs, pid = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]))
+
+    from spark_rapids_tpu.parallel.multiprocess import (
+        init_multiprocess, run_distributed_mp)
+
+    mesh = init_multiprocess(coordinator, nprocs, pid,
+                             local_cpu_devices=4)
+
+    import numpy as np
+
+    from spark_rapids_tpu import Session
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.RandomState(123)
+    orders = {"o_custkey": rng.randint(0, 60, 500),
+              "o_total": (rng.rand(500) * 1000).round(6)}
+    cust = {"c_custkey": np.arange(60),
+            "c_nation": rng.randint(0, 6, 60)}
+
+    sess = Session({
+        "spark.rapids.tpu.telemetry.enabled": True,
+        # force the shuffled-join path so the cross-process collective
+        # carries the data the events describe
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+    })
+    o = sess.create_dataframe(dict(orders))
+    c = sess.create_dataframe(dict(cust))
+    j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+    df = j.group_by("c_nation").agg(F.sum("o_total").alias("rev"))
+
+    got = sorted(run_distributed_mp(sess, df, mesh).to_rows())
+    assert got, "empty result"
+
+    prof = sess.last_profile
+    assert prof is not None, "telemetry profile missing"
+    events = prof.events.snapshot()
+    local = [e for e in events if "proc" not in e]
+    shipped = [e for e in events if e.get("proc") is not None]
+    assert local, "no local events"
+    assert shipped, f"no shipped peer events (got {len(events)})"
+    assert all(e["proc"] != pid for e in shipped), shipped[:3]
+    kinds = {e["event"] for e in shipped}
+    assert "query_begin" in kinds, kinds
+
+    print(f"MP TELEMETRY OK pid={pid} local={len(local)} "
+          f"shipped={len(shipped)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""AST lint: telemetry emitter and thread-spawn discipline.
+
+Two invariants the telemetry subsystem's correctness rests on, enforced
+mechanically so refactors cannot silently regress them:
+
+1. **Exception-safe emitters** — outside ``telemetry/``, event
+   emission may ONLY go through ``telemetry.events.emit_event`` (which
+   never raises and is a no-op when inactive).  A bare ``.emit(...)``
+   call in an engine module could throw from inside a recovery path.
+2. **Worker threads capture the span/query context** — thread-locals
+   do not cross thread spawns, so every ``Thread``/
+   ``ThreadPoolExecutor`` spawn site in the package must capture the
+   telemetry binding (``spans.capture``/``bound``/``attached``) in the
+   same enclosing function.  A missed capture silently drops every
+   span/event the worker would have produced.
+"""
+import ast
+import os
+
+PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "spark_rapids_tpu")
+
+SPAWN_NAMES = {"Thread", "ThreadPoolExecutor", "Timer",
+               "ProcessPoolExecutor"}
+CAPTURE_NAMES = {"capture", "bound", "attached"}
+
+
+def _package_files():
+    for root, _dirs, files in os.walk(PKG):
+        for fn in files:
+            if fn.endswith(".py"):
+                yield os.path.join(root, fn)
+
+
+def _terminal_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_telemetry_module(path: str) -> bool:
+    return os.sep + "telemetry" + os.sep in path
+
+
+def test_no_bare_emit_outside_telemetry():
+    offenders = []
+    for path in _package_files():
+        if _is_telemetry_module(path):
+            continue
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) == "emit":
+                offenders.append(f"{path}:{node.lineno}")
+    assert not offenders, \
+        "bare .emit() outside telemetry/ — use the exception-safe " \
+        f"telemetry.events.emit_event instead: {offenders}"
+
+
+def test_emit_event_is_exception_safe_by_construction():
+    """The one emitter engine code is allowed to call must wrap its
+    body in a swallow-all try/except (it sits inside recovery paths)."""
+    path = os.path.join(PKG, "telemetry", "events.py")
+    tree = ast.parse(open(path).read(), filename=path)
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef)
+              and n.name == "emit_event")
+    tries = [n for n in fn.body if isinstance(n, ast.Try)]
+    assert tries, "emit_event must wrap its body in try/except"
+    handlers = [h for t in tries for h in t.handlers]
+    assert any(
+        h.type is None
+        or (isinstance(h.type, ast.Name)
+            and h.type.id in ("Exception", "BaseException"))
+        for h in handlers), \
+        "emit_event must swallow Exception — telemetry must never " \
+        "break a recovery path"
+
+
+class _SpawnVisitor(ast.NodeVisitor):
+    """Records, for every thread-spawn call, the call node itself and
+    its innermost enclosing function (module level counts as None)."""
+
+    def __init__(self):
+        self.stack = []
+        self.spawns = []  # (call node, enclosing function node or None)
+
+    def _visit_fn(self, node):
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node):
+        if _terminal_name(node.func) in SPAWN_NAMES:
+            self.spawns.append(
+                (node, self.stack[-1] if self.stack else None))
+        self.generic_visit(node)
+
+
+def _has_capture(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) \
+                and _terminal_name(n.func) in CAPTURE_NAMES:
+            return True
+    return False
+
+
+def test_every_thread_spawn_site_captures_telemetry_context():
+    offenders = []
+    found_spawns = 0
+    for path in _package_files():
+        tree = ast.parse(open(path).read(), filename=path)
+        v = _SpawnVisitor()
+        v.visit(tree)
+        for call, fn in v.spawns:
+            found_spawns += 1
+            name = _terminal_name(call.func)
+            if name == "Thread":
+                # per-SITE check: the Thread(...) call itself must
+                # wrap its target with bound()/attached()/capture() —
+                # a second unwrapped Thread in an already-compliant
+                # function must not ride the first one's capture
+                ok = _has_capture(call)
+            else:
+                # pool executors: the map/submit wrapping happens next
+                # to the constructor, so check the enclosing function
+                ok = fn is not None and _has_capture(fn)
+            if not ok:
+                offenders.append(f"{path}:{call.lineno}")
+    # the engine definitely spawns workers — an empty scan means the
+    # lint itself broke, not that the invariant holds
+    assert found_spawns >= 5, \
+        f"spawn-site scan found only {found_spawns} sites — lint broken?"
+    assert not offenders, \
+        "thread-spawn sites missing a telemetry-context capture " \
+        "(wrap the Thread target with spans.bound(spans.capture(), " \
+        f"fn), or capture in the pool's enclosing function): {offenders}"
