@@ -133,7 +133,7 @@ def dump_markdown() -> str:
             continue
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
     lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC,
-              "", _OBSERVABILITY_DOC]
+              "", _OBSERVABILITY_DOC, "", _PERF_TUNING_DOC]
     return "\n".join(lines)
 
 
@@ -198,6 +198,36 @@ Recovery is observable: `fault.numStageRetries`,
 `fault.numChecksumFailures`, `fault.numWatchdogTrips` and
 `fault.degradeLevel` land in `Session.last_metrics`, and a degraded
 query logs a DEGRADED summary."""
+
+
+_PERF_TUNING_DOC = """\
+## Whole-stage fusion & kernel cache
+
+The `fusion.*` and `kernelCache.*` confs (table above) configure the
+compute hot path (`plan/fusion.py`, `exec/kernel_cache.py`,
+docs/perf_tuning.md):
+
+* **Whole-stage fusion** — maximal chains of row-local device operators
+  (Project, Filter, Expand, Generate) are collapsed into one
+  `TpuFusedSegmentExec` whose single jitted kernel composes the member
+  compute bodies, so a Project -> Filter -> Project chain issues one
+  XLA dispatch per batch instead of three and materializes no
+  intermediate batch in HBM.  Filters fuse by threading their keep mask
+  through the segment and compacting once at segment exit — results
+  stay bit-identical to the unfused plan.  Fusion stops at exchanges,
+  aggregates, sorts, joins, transitions and nondeterministic
+  expressions; `fusion.maxSegmentExecs` bounds segment size.
+* **Shared kernel cache** — every device exec routes jit compilation
+  through the process-wide `KernelCache`, keyed by kernel fingerprint
+  and schema signature (the row-bucket dimension rides the underlying
+  jax shape cache), so identical operators across plans share one
+  compiled executable.  `donate_argnums` buffer donation is applied on
+  non-CPU backends for segments whose input batches are provably
+  single-consumer.  Hit/miss/compile-wall counters land in
+  `Session.last_metrics` under `kernelCache.*`, a per-exec
+  `compileTime` metric attributes compile wall to operators in
+  EXPLAIN ANALYZE, and `bench.py` reports cold (compile-inclusive) vs
+  warm timings plus the per-query hit rate."""
 
 
 _OBSERVABILITY_DOC = """\
@@ -441,6 +471,39 @@ CAST_STRING_TO_TIMESTAMP = conf(
 # Spark's shortest-repr formatting has no faithful device analogue, see
 # ops/cast.py; the reference gates the same divergence behind its
 # castFloatToString conf)
+
+# --- whole-stage fusion / kernel cache (plan/fusion.py,
+# exec/kernel_cache.py; reference: the per-operator dispatch overhead
+# called out by "Data Path Fusion in GPU for Analytical Query
+# Processing" — see docs/perf_tuning.md) ----------------------------------
+FUSION_ENABLED = conf("spark.rapids.tpu.sql.fusion.enabled").doc(
+    "Collapse maximal chains of row-local device execs (Project, "
+    "Filter, Expand, Generate) into one fused segment whose single "
+    "jitted kernel composes the member compute bodies — one XLA "
+    "dispatch per batch per segment, no intermediate HBM "
+    "materialization; results are bit-identical to the unfused plan"
+).boolean_conf(True)
+FUSION_MAX_SEGMENT_EXECS = conf(
+    "spark.rapids.tpu.sql.fusion.maxSegmentExecs").doc(
+    "Upper bound on member execs per fused segment; a longer row-local "
+    "chain is split into several segments (guards XLA compile time on "
+    "pathological plans)").int_conf(16)
+KERNEL_CACHE_ENABLED = conf("spark.rapids.tpu.sql.kernelCache.enabled").doc(
+    "Share jit-compiled kernels across exec instances through the "
+    "process-wide KernelCache, keyed by kernel fingerprint and schema "
+    "signature (the row-bucket dimension rides the jax shape cache). "
+    "Disabled, each exec instance compiles privately; cache counters "
+    "still report").boolean_conf(True)
+KERNEL_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.sql.kernelCache.maxEntries").doc(
+    "LRU capacity of the shared kernel cache (entries hold compiled "
+    "XLA executables; eviction frees them)").int_conf(256)
+KERNEL_CACHE_DONATION = conf(
+    "spark.rapids.tpu.sql.kernelCache.donation.enabled").doc(
+    "Donate input batch buffers (jax donate_argnums) to kernels whose "
+    "input is provably single-consumer — fused segments fed by fresh "
+    "file-scan uploads — so XLA reuses the HBM in place.  No-op on the "
+    "CPU backend, which ignores donation").boolean_conf(True)
 
 # --- test hooks (:456-463) ------------------------------------------------
 TEST_ENABLED = conf("spark.rapids.tpu.sql.test.enabled").doc(

@@ -71,18 +71,28 @@ class TpuHashAggregateExec(TpuExec):
         self.keys = plan.keys
         self.specs = plan.specs
         self._schema = plan.schema
-        import jax
+        from .kernel_cache import (expr_signature, jit_kernel,
+                                   schema_signature)
 
-        self._kernel = jax.jit(self.compute_batch)
+        sig = ("agg", self.mode, schema_signature(child.schema),
+               expr_signature(self.keys),
+               tuple(sp.func.sql() for sp in self.specs),
+               schema_signature(plan.schema))
+        twin = self.kernel_twin()
+        self._kernel = jit_kernel(twin.compute_batch,
+                                  key=sig + ("batch",))
         # chunked-path kernels (used only when a partition spans batches)
-        self._update_kernel = jax.jit(
-            lambda b: self._compute(b, "update", "buffers"))
-        self._merge_kernel = jax.jit(
-            lambda b: self._compute(b, "merge", "buffers"))
+        self._update_kernel = jit_kernel(
+            lambda b: twin._compute(b, "update", "buffers"),
+            key=sig + ("update",))
+        self._merge_kernel = jit_kernel(
+            lambda b: twin._compute(b, "merge", "buffers"),
+            key=sig + ("merge",))
         # only reached from _agg_chunked when mode is final/complete
         # (partial returns the running buffers before finalize)
-        self._merge_final_kernel = jax.jit(
-            lambda b: self._compute(b, "merge", "final"))
+        self._merge_final_kernel = jit_kernel(
+            lambda b: twin._compute(b, "merge", "final"),
+            key=sig + ("merge_final",))
 
     def compute_batch(self, batch: DeviceBatch) -> DeviceBatch:
         """The mode's full aggregation over one batch (trace-safe; also

@@ -70,6 +70,17 @@ class DevicePartitionedData:
         return self.parts[pid]()
 
 
+class _SchemaStub:
+    """Stands in for a child subtree on a kernel twin (kernel_twin):
+    compute bodies may read ``children[i].schema`` while tracing, but a
+    cached kernel must never retain the live child exec."""
+
+    __slots__ = ("schema",)
+
+    def __init__(self, schema):
+        self.schema = schema
+
+
 class TpuExec(PhysicalPlan):
     """Base of all device operators."""
 
@@ -87,6 +98,9 @@ class TpuExec(PhysicalPlan):
             M.TOTAL_TIME: reg.metric(prefix + M.TOTAL_TIME, "ns"),
             M.PEAK_DEVICE_MEMORY: reg.metric(
                 prefix + M.PEAK_DEVICE_MEMORY, "max"),
+            # compile-inclusive wall of first-shape dispatches, fed by
+            # the KernelCache when this exec's dispatch compiled
+            M.COMPILE_TIME: reg.metric(prefix + M.COMPILE_TIME, "ns"),
         }
         # telemetry: one exec-kind span per physical exec name, plus the
         # deviceSyncTime metric the transitions feed — both exist ONLY
@@ -98,6 +112,23 @@ class TpuExec(PhysicalPlan):
             self.metrics[M.DEVICE_SYNC_TIME] = reg.metric(
                 prefix + M.DEVICE_SYNC_TIME, "ns")
             tspans.register_exec(self)
+
+    def kernel_twin(self) -> "TpuExec":
+        """A children-detached shallow copy for KernelCache registration.
+
+        A registered cache entry outlives the query — that is the point
+        of cross-query kernel sharing — so a kernel bound to ``self``
+        would pin the whole plan subtree (and anything the subtree
+        finalizes on collection, e.g. HostToDeviceExec's cached upload
+        buffers) for the life of the process.  The twin keeps the
+        expression/schema state the compute body needs and swaps each
+        child for a schema-only stub.
+        """
+        import copy
+
+        twin = copy.copy(self)
+        twin.children = [_SchemaStub(c.schema) for c in self.children]
+        return twin
 
     @property
     def supports_columnar(self) -> bool:

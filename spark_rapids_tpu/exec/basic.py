@@ -16,12 +16,7 @@ from ..ops.kernels.gather import compact
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
 from .base import DevicePartitionedData, TpuExec
-
-
-def _jit(fn):
-    import jax
-
-    return jax.jit(fn)
+from .kernel_cache import expr_signature, jit_kernel, schema_signature
 
 
 class TpuProjectExec(TpuExec):
@@ -34,7 +29,10 @@ class TpuProjectExec(TpuExec):
                 T.Field(output_name(raw, i), b.dtype, b.nullable)
                 for i, (raw, b) in enumerate(zip(exprs, self.exprs))])
         self._schema = schema
-        self._kernel = _jit(self._compute)
+        self._kernel = jit_kernel(
+            self.kernel_twin()._compute,
+            key=("project", schema_signature(child.schema),
+                 expr_signature(self.exprs), schema_signature(schema)))
 
     @property
     def schema(self):
@@ -58,7 +56,7 @@ class TpuProjectExec(TpuExec):
                 for db in child.iterator(pid):
                     with trace_range("TpuProject",
                                      self.metrics[M.TOTAL_TIME]):
-                        out = self._kernel(db)
+                        out = self._kernel(db, metrics=self.metrics)
                     self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
                     yield out
 
@@ -75,7 +73,10 @@ class TpuFilterExec(TpuExec):
     def __init__(self, child, condition: Expression):
         super().__init__([child])
         self.condition = bind_references(condition, child.schema)
-        self._kernel = _jit(self._compute)
+        self._kernel = jit_kernel(
+            self.kernel_twin()._compute,
+            key=("filter", schema_signature(child.schema),
+                 expr_signature([self.condition])))
 
     @property
     def schema(self):
@@ -85,11 +86,16 @@ class TpuFilterExec(TpuExec):
     def coalesce_after(self):
         return True
 
-    def _compute(self, batch: DeviceBatch) -> DeviceBatch:
+    def _keep(self, batch: DeviceBatch):
+        """The keep mask of ``condition`` over ``batch`` — shared with
+        the fused-segment kernel, which threads the mask through the
+        segment instead of compacting per filter."""
         c = as_device_column(self.condition.eval_tpu(batch),
                              batch.padded_rows)
-        keep = c.data & c.validity
-        return compact(batch, keep)
+        return c.data & c.validity
+
+    def _compute(self, batch: DeviceBatch) -> DeviceBatch:
+        return compact(batch, self._keep(batch))
 
     def execute_columnar(self, ctx):
         child = self.children[0].execute_columnar(ctx)
@@ -100,7 +106,7 @@ class TpuFilterExec(TpuExec):
                 for db in child.iterator(pid):
                     with trace_range("TpuFilter",
                                      self.metrics[M.TOTAL_TIME]):
-                        out = self._kernel(db)
+                        out = self._kernel(db, metrics=self.metrics)
                     self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
                     yield out
 
@@ -194,8 +200,17 @@ class TpuExpandExec(TpuExec):
         first = self.projections[0]
         self._schema = T.Schema([T.Field(n, b.dtype, True)
                                  for n, b in zip(output_names, first)])
-        self._kernels = [_jit(self._mk_kernel(ps))
-                         for ps in self.projections]
+        # raw bodies kept for the fused-segment / distributed lowering;
+        # built on the kernel twin so neither the registered kernels nor
+        # a fused segment holding _kernel_fns pins this exec's subtree
+        twin = self.kernel_twin()
+        self._kernel_fns = [twin._mk_kernel(ps) for ps in self.projections]
+        self._kernels = [
+            jit_kernel(fn, key=("expand",
+                                schema_signature(child.schema),
+                                expr_signature(ps),
+                                schema_signature(self._schema)))
+            for fn, ps in zip(self._kernel_fns, self.projections)]
 
     @property
     def schema(self):
@@ -231,7 +246,7 @@ class TpuExpandExec(TpuExec):
                     for k in self._kernels:
                         with trace_range("TpuExpand",
                                          self.metrics[M.TOTAL_TIME]):
-                            yield k(db)
+                            yield k(db, metrics=self.metrics)
 
             return it
 

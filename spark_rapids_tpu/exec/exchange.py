@@ -144,19 +144,21 @@ class TpuShuffleExchangeExec(TpuExec):
         self.plan = plan  # physical.ShuffleExchangeExec
         self.partitioning = plan.partitioning
         self.n_out = plan.n_out
-        import jax
+        from .kernel_cache import jit_kernel
 
-        self._hash_kernel = jax.jit(self._hash_pids)
-        self._slice_kernel = jax.jit(self._slice)
+        # partitioning objects carry bound key state with no canonical
+        # fingerprint — compile privately (key=None); counters still apply
+        self._hash_kernel = jit_kernel(self._hash_pids)
+        self._slice_kernel = jit_kernel(self._slice)
         if isinstance(self.partitioning, RangePartitioning):
-            self._passes_kernel = jax.jit(
+            self._passes_kernel = jit_kernel(
                 lambda b: range_key_passes(
                     b, self.partitioning._bound_keys))
-            self._range_pid_kernel = jax.jit(
+            self._range_pid_kernel = jit_kernel(
                 lambda b, bounds: range_pids_from_bounds(
                     range_key_passes(b, self.partitioning._bound_keys),
                     bounds))
-            self._bounds_pid_kernel = jax.jit(range_pids_from_bounds)
+            self._bounds_pid_kernel = jit_kernel(range_pids_from_bounds)
             import jax.numpy as jnp
 
             def _sample(passes, nr):
@@ -166,7 +168,7 @@ class TpuShuffleExchangeExec(TpuExec):
                        ) // RANGE_SAMPLES_PER_BATCH
                 return passes[:, idx]
 
-            self._sample_kernel = jax.jit(_sample)
+            self._sample_kernel = jit_kernel(_sample)
 
     @property
     def schema(self):

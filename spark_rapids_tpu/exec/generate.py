@@ -19,12 +19,7 @@ from ..ops.expression import Expression, as_device_column, bind_references
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
 from .base import DevicePartitionedData, TpuExec
-
-
-def _jit(fn):
-    import jax
-
-    return jax.jit(fn)
+from .kernel_cache import expr_signature, jit_kernel, schema_signature
 
 
 class TpuGenerateExec(TpuExec):
@@ -35,7 +30,11 @@ class TpuGenerateExec(TpuExec):
         self.position = plan.position
         self._schema = plan_schema = plan.schema
         self._out_dtype = plan_schema.fields[-1].dtype
-        self._kernel = _jit(self._compute)
+        self._kernel = jit_kernel(
+            self.kernel_twin()._compute,
+            key=("generate", schema_signature(child.schema),
+                 expr_signature(self.elements), bool(self.position),
+                 str(self._out_dtype), schema_signature(plan_schema)))
 
     @property
     def schema(self):
@@ -97,7 +96,7 @@ class TpuGenerateExec(TpuExec):
                 for db in child.iterator(pid):
                     with trace_range("TpuGenerate",
                                      self.metrics[M.TOTAL_TIME]):
-                        out = self._kernel(db)
+                        out = self._kernel(db, metrics=self.metrics)
                     self.metrics[M.NUM_OUTPUT_ROWS].add(int(out.num_rows))
                     self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
                     yield out

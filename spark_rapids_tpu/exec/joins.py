@@ -73,11 +73,24 @@ class TpuHashJoinExec(TpuExec):
             plan.left_keys, plan.right_keys)
         self.condition = plan.condition
         self._schema = plan.schema
-        import jax
+        from .kernel_cache import (expr_signature, jit_kernel,
+                                   schema_signature)
 
-        self._count_kernel = jax.jit(self._count)
-        self._expand_kernel = jax.jit(self._expand, static_argnums=0)
-        self._semi_kernel = jax.jit(self._semi_anti)
+        sig = ("join", type(self).__name__, self.how,
+               expr_signature(self.left_keys),
+               expr_signature(self.right_keys),
+               self.condition.sql() if self.condition is not None
+               else None,
+               schema_signature(left.schema),
+               schema_signature(right.schema),
+               schema_signature(plan.schema))
+        twin = self.kernel_twin()
+        self._count_kernel = jit_kernel(twin._count,
+                                        key=sig + ("count",))
+        self._expand_kernel = jit_kernel(twin._expand, static_argnums=(0,),
+                                         key=sig + ("expand",))
+        self._semi_kernel = jit_kernel(twin._semi_anti,
+                                       key=sig + ("semi",))
 
     @property
     def schema(self):

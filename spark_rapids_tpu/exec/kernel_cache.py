@@ -1,0 +1,247 @@
+"""Process-wide kernel-compilation cache.
+
+Every device exec routes its jit compilation through here instead of
+calling ``jax.jit`` directly (enforced by the AST lint in
+tests/test_lint_kernel_cache.py), which buys three things the scattered
+per-exec ``_jit`` helpers could not:
+
+* **Sharing** — entries are keyed by a kernel *fingerprint* (operator
+  kind + bound-expression signatures) plus the input/output *schema
+  signatures*; two exec instances computing the same thing over the
+  same layout hand out ONE wrapped callable and with it one underlying
+  jax executable cache.  The third key dimension of the design — the
+  row bucket — rides the jax shape cache inside each entry: batches
+  are padded to power-of-two buckets (``bucketMinRows``), so jax's own
+  per-shape cache keys exactly on the bucket.
+* **Telemetry** — per-dispatch hit/miss detection (via the jit
+  wrapper's cache-size delta), compile-inclusive wall of first-shape
+  dispatches, dispatch and eviction counters.  ``Session`` merges the
+  per-query delta into ``last_metrics`` under ``kernelCache.*``; the
+  per-exec ``compileTime`` metric attributes compile wall to the
+  dispatching operator in EXPLAIN ANALYZE.
+* **Donation** — ``donate_argnums`` buffer donation for call sites
+  whose input batch is provably single-consumer (fused segments over
+  fresh file-scan uploads), applied only on backends that honor it
+  (the CPU backend ignores donation, so tests exercise the plumbing
+  but never the aliasing).
+
+Conf-gated by ``spark.rapids.tpu.sql.kernelCache.{enabled,maxEntries,
+donation.enabled}``; the cache is process-global like the
+DeviceManager, (re)configured by each device Session.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional, Tuple
+
+from ..utils import metrics as M
+
+
+def schema_signature(schema) -> Tuple:
+    """Hashable fingerprint of a schema: (name, dtype, nullable) per
+    field.  Names matter — the output schema is static aux data baked
+    into the compiled closure's DeviceBatch pytree."""
+    return tuple((f.name, str(f.dtype), bool(f.nullable))
+                 for f in schema)
+
+
+def expr_signature(exprs) -> Tuple:
+    """Hashable fingerprint of bound expressions: canonical SQL plus
+    result dtype (sql() prints the full bound tree, so equal
+    signatures imply equal computations for deterministic exprs)."""
+    return tuple((e.sql(), str(e.dtype)) for e in exprs)
+
+
+class _CachedKernel:
+    """A jitted kernel wrapped with dispatch accounting.
+
+    ``__call__(*args, metrics=None)``: dispatches the underlying jax
+    executable; when the dispatch triggered a compile (first call for
+    this arg-shape bucket), the compile-inclusive wall is recorded
+    globally and — when ``metrics`` (an exec's metric dict) is given —
+    attributed to the dispatching exec's ``compileTime`` metric.
+    """
+
+    __slots__ = ("_cache", "fn", "_jfn", "donated")
+
+    def __init__(self, cache: "KernelCache", fn: Callable,
+                 static_argnums: Tuple[int, ...],
+                 donate_argnums: Tuple[int, ...]):
+        import jax
+
+        self._cache = cache
+        self.fn = fn  # the raw traceable body (runner/fusion reuse it)
+        self.donated = bool(donate_argnums) and cache.donation_active()
+        kwargs = {}
+        if static_argnums:
+            kwargs["static_argnums"] = tuple(static_argnums)
+        if self.donated:
+            kwargs["donate_argnums"] = tuple(donate_argnums)
+        self._jfn = jax.jit(fn, **kwargs)
+
+    def _shape_cache_size(self) -> Optional[int]:
+        try:
+            return self._jfn._cache_size()
+        except Exception:  # noqa: BLE001 - private jax API moved
+            return None
+
+    def __call__(self, *args, metrics=None):
+        before = self._shape_cache_size()
+        t0 = time.perf_counter_ns()
+        out = self._jfn(*args)
+        if before is None:
+            self._cache._count(dispatches=1)
+            return out
+        after = self._shape_cache_size()
+        if after is not None and after > before:
+            dt = time.perf_counter_ns() - t0
+            self._cache._count(dispatches=1, misses=1, compileTimeNs=dt)
+            if metrics is not None:
+                m = metrics.get(M.COMPILE_TIME)
+                if m is not None:
+                    m.add(dt)
+        else:
+            self._cache._count(dispatches=1, hits=1)
+        return out
+
+
+class KernelCache:
+    """LRU registry of :class:`_CachedKernel` entries keyed by kernel
+    fingerprint (see module doc).  Thread-safe; counters monotonic
+    until :meth:`reset`."""
+
+    _DEFAULT_MAX_ENTRIES = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict" = OrderedDict()
+        self.enabled = True
+        self.max_entries = self._DEFAULT_MAX_ENTRIES
+        self.donation_enabled = True
+        self._counters = self._zero_counters()
+
+    @staticmethod
+    def _zero_counters():
+        return {"hits": 0, "misses": 0, "dispatches": 0,
+                "compileTimeNs": 0, "evictions": 0, "sharedKernels": 0}
+
+    # ---------------- configuration / lifecycle -----------------------
+    def configure(self, conf) -> None:
+        """Adopt a Session's kernelCache.* settings (process-global,
+        like the DeviceManager: the most recent device Session wins)."""
+        from ..config import (KERNEL_CACHE_DONATION, KERNEL_CACHE_ENABLED,
+                              KERNEL_CACHE_MAX_ENTRIES)
+
+        with self._lock:
+            self.enabled = bool(conf.get(KERNEL_CACHE_ENABLED))
+            self.max_entries = max(1, int(
+                conf.get(KERNEL_CACHE_MAX_ENTRIES)))
+            self._evict_locked()
+
+        self.donation_enabled = bool(conf.get(KERNEL_CACHE_DONATION))
+
+    def reset(self) -> None:
+        """Drop every entry and zero every counter (test isolation —
+        wired as an autouse fixture in tests/conftest.py).  Kernels
+        already handed out keep working; they just stop being shared."""
+        with self._lock:
+            self._entries.clear()
+            self._counters = self._zero_counters()
+            self.enabled = True
+            self.max_entries = self._DEFAULT_MAX_ENTRIES
+            self.donation_enabled = True
+
+    def donation_active(self) -> bool:
+        """Donation applies only where the backend honors it — the CPU
+        backend silently ignores donated buffers (and warns)."""
+        if not self.donation_enabled:
+            return False
+        try:
+            import jax
+
+            return jax.default_backend() != "cpu"
+        except Exception:  # noqa: BLE001 - backend not initializable
+            return False
+
+    # ---------------- counters ----------------------------------------
+    def _count(self, **kv) -> None:
+        with self._lock:
+            for k, v in kv.items():
+                self._counters[k] += v
+
+    def counters(self):
+        with self._lock:
+            return dict(self._counters)
+
+    @property
+    def num_entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def metrics_since(self, mark) -> dict:
+        """Per-query ``kernelCache.*`` metric section: counter deltas
+        since ``mark`` (a :meth:`counters` snapshot taken at query
+        start by ExecContext) plus the absolute entry count."""
+        cur = self.counters()
+        out = {}
+        for k, v in cur.items():
+            base = mark.get(k, 0) if mark else 0
+            out[f"kernelCache.{k}"] = v - base
+        out["kernelCache.numEntries"] = self.num_entries
+        return out
+
+    # ---------------- the entry point ----------------------------------
+    def get(self, fn: Callable, *, key=None,
+            static_argnums: Tuple[int, ...] = (),
+            donate_argnums: Tuple[int, ...] = ()) -> _CachedKernel:
+        """Wrap ``fn`` for jit dispatch through the cache.
+
+        ``key=None`` (or cache disabled) compiles privately per call
+        site — no sharing, but dispatches still count.  A non-None key
+        MUST capture everything the closure reads (operator kind,
+        bound-expression signatures, input/output schema signatures):
+        the first caller's closure serves every later caller.
+
+        Lifetime discipline: a registered entry outlives the query, so
+        an exec-bound body must be registered through
+        ``TpuExec.kernel_twin()`` — a kernel bound to the live exec
+        would pin its plan subtree (and whatever the subtree's GC
+        finalizers free, e.g. HostToDeviceExec's cached upload buffers)
+        for the life of the process."""
+        use_key = None
+        if key is not None and self.enabled:
+            use_key = (key, tuple(static_argnums),
+                       tuple(donate_argnums), self.donation_active())
+            with self._lock:
+                hit = self._entries.get(use_key)
+                if hit is not None:
+                    self._entries.move_to_end(use_key)
+                    self._counters["sharedKernels"] += 1
+                    return hit
+        kern = _CachedKernel(self, fn, static_argnums, donate_argnums)
+        if use_key is not None:
+            with self._lock:
+                self._entries.setdefault(use_key, kern)
+                self._entries.move_to_end(use_key)
+                self._evict_locked()
+        return kern
+
+    def _evict_locked(self) -> None:
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self._counters["evictions"] += 1
+
+
+#: THE process-wide cache instance (analogue: DeviceManager singleton)
+GLOBAL = KernelCache()
+
+
+def jit_kernel(fn: Callable, *, key=None,
+               static_argnums: Tuple[int, ...] = (),
+               donate_argnums: Tuple[int, ...] = ()) -> _CachedKernel:
+    """Module-level sugar over ``GLOBAL.get`` — the one way execs
+    compile kernels (replaces the per-module ``_jit`` helpers)."""
+    return GLOBAL.get(fn, key=key, static_argnums=static_argnums,
+                      donate_argnums=donate_argnums)

@@ -50,10 +50,18 @@ class TpuSortExec(TpuExec):
     def __init__(self, child, keys):
         super().__init__([child])
         self.keys = keys  # List[functions.SortKey], exprs already bound
-        import jax
+        from .kernel_cache import jit_kernel, schema_signature
 
-        self._kernel = jax.jit(self._compute)
-        self._order_kernel = jax.jit(self._order)
+        key_sig = tuple((k.expr.sql(), str(k.expr.dtype),
+                         bool(k.ascending), bool(k.nulls_first))
+                        for k in keys)
+        twin = self.kernel_twin()
+        self._kernel = jit_kernel(
+            twin._compute,
+            key=("sort", schema_signature(child.schema), key_sig))
+        self._order_kernel = jit_kernel(
+            twin._order,
+            key=("sort_order", schema_signature(child.schema), key_sig))
 
     @property
     def schema(self):

@@ -82,9 +82,11 @@ class TpuWindowExec(TpuExec):
         self.plan = plan  # window_cpu.WindowExec (exprs already bound)
         self.window_exprs = plan.window_exprs
         self._schema = plan.schema
-        import jax
+        from .kernel_cache import jit_kernel
 
-        self._kernel = jax.jit(self._compute)
+        # window frames/specs have no compact canonical fingerprint —
+        # compile privately (key=None), dispatch counters still apply
+        self._kernel = jit_kernel(self._compute)
 
     @property
     def schema(self):

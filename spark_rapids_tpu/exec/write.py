@@ -41,9 +41,9 @@ class TpuDataWritingCommandExec(TpuExec):
     def __init__(self, child, plan):
         super().__init__([child])
         self.plan = plan  # physical.DataWritingCommandExec
-        import jax
+        from .kernel_cache import jit_kernel
 
-        self._sort_kernel = jax.jit(self._sort_by_keys)
+        self._sort_kernel = jit_kernel(self._sort_by_keys)
 
     @property
     def schema(self):

@@ -262,6 +262,7 @@ class DistributedRunner:
         from ..exec.aggregate import TpuHashAggregateExec
         from ..exec.coalesce import TpuCoalesceBatchesExec
         from ..exec.exchange import TpuShuffleExchangeExec
+        from ..exec.fused import TpuFusedSegmentExec
         from ..exec.generate import TpuGenerateExec
         from ..exec.joins import TpuHashJoinExec
         from ..exec.sort import TpuSortExec
@@ -271,7 +272,8 @@ class DistributedRunner:
                          B.TpuLocalLimitExec, B.TpuExpandExec,
                          B.TpuUnionExec, TpuHashAggregateExec,
                          TpuCoalesceBatchesExec, TpuSortExec,
-                         TpuWindowExec, TpuGenerateExec, TpuHashJoinExec)
+                         TpuWindowExec, TpuGenerateExec, TpuHashJoinExec,
+                         TpuFusedSegmentExec)
 
         if isinstance(node, TpuShuffleExchangeExec):
             # the exchange terminates its producing stage
@@ -685,6 +687,7 @@ class DistributedRunner:
         from ..exec.aggregate import TpuHashAggregateExec
         from ..exec.coalesce import TpuCoalesceBatchesExec
         from ..exec.exchange import TpuShuffleExchangeExec
+        from ..exec.fused import TpuFusedSegmentExec
         from ..exec.generate import TpuGenerateExec
         from ..exec.joins import (TpuBroadcastHashJoinExec,
                                   TpuHashJoinExec)
@@ -753,7 +756,9 @@ class DistributedRunner:
                 return out
             if isinstance(op, (B.TpuExpandExec,)):
                 child = self._lower(kids[0], env, aux, caps, used_caps)
-                pieces = [k(child) for k in op._kernels]
+                # raw bodies: the enclosing shard_map trace must not
+                # nest the locally-jitted (and cache-counted) kernels
+                pieces = [fn(child) for fn in op._kernel_fns]
                 return self._concat_compact(pieces, op.schema)
             if isinstance(op, B.TpuUnionExec):
                 pieces = [self._lower(k, env, aux, caps, used_caps)
@@ -817,6 +822,14 @@ class DistributedRunner:
                                TpuGenerateExec)):
                 child = self._lower(kids[0], env, aux, caps, used_caps)
                 return op._compute(child)
+            if isinstance(op, TpuFusedSegmentExec):
+                child = self._lower(kids[0], env, aux, caps, used_caps)
+                # same composed body the local jitted segment runs;
+                # expand members fan out into multiple streams
+                pieces = list(op._compute(child))
+                if len(pieces) == 1:
+                    return pieces[0]
+                return self._concat_compact(pieces, op.schema)
         raise DistributedUnsupported(f"cannot lower {node!r}")
 
     @staticmethod

@@ -1,0 +1,96 @@
+"""Whole-stage fusion: the post-planner physical rewrite.
+
+Collapses maximal chains of row-local device execs into one
+``TpuFusedSegmentExec`` (exec/fused.py) whose single jitted kernel
+composes the member compute bodies — one XLA dispatch per batch per
+segment instead of one per operator, and no intermediate DeviceBatch
+materialized in HBM between members.
+
+Runs inside ``TpuTransitionOverrides.apply`` AFTER transition
+cancellation (a cancelled DeviceToHost/HostToDevice pair can join two
+row-local chains) and BEFORE coalesce insertion (the segment inherits
+the bottom member's child goal and the members' ``coalesce_after``, so
+coalesce placement around the segment matches the unfused plan).
+
+Segment boundaries — fusion stops at:
+  * anything not row-local: exchanges, aggregates, sorts, joins,
+    limits, unions, coalesces and transitions (they are simply not in
+    the fusable set);
+  * nondeterministic expressions (rand(), partition-id/row-position
+    dependent values change meaning when compaction is deferred);
+  * ``fusion.maxSegmentExecs`` — a longer chain becomes several
+    segments.
+"""
+from __future__ import annotations
+
+from ..config import (FUSION_ENABLED, FUSION_MAX_SEGMENT_EXECS,
+                      KERNEL_CACHE_DONATION, TpuConf)
+from ..exec.basic import TpuExpandExec, TpuFilterExec, TpuProjectExec
+from ..exec.fused import TpuFusedSegmentExec
+from ..exec.generate import TpuGenerateExec
+from ..exec.transitions import HostToDeviceExec
+from . import physical as P
+
+#: the row-local execs whose compute bodies compose (ISSUE: Project,
+#: Filter, Expand, Generate-where-row-local, adjacent projections)
+_ROW_LOCAL = (TpuProjectExec, TpuFilterExec, TpuExpandExec,
+              TpuGenerateExec)
+
+
+def _member_exprs(node):
+    if isinstance(node, TpuProjectExec):
+        return node.exprs
+    if isinstance(node, TpuFilterExec):
+        return [node.condition]
+    if isinstance(node, TpuExpandExec):
+        return [e for ps in node.projections for e in ps]
+    if isinstance(node, TpuGenerateExec):
+        return node.elements
+    return []
+
+
+class TpuFusionPass:
+    def __init__(self, conf: TpuConf):
+        self.enabled = bool(conf.get(FUSION_ENABLED))
+        self.max_members = max(2, int(conf.get(FUSION_MAX_SEGMENT_EXECS)))
+        self.donation = bool(conf.get(KERNEL_CACHE_DONATION))
+
+    def apply(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
+        if not self.enabled:
+            return plan
+        return self._rewrite(plan)
+
+    # ------------------------------------------------------------------
+    def _fusable(self, node) -> bool:
+        return isinstance(node, _ROW_LOCAL) \
+            and len(node.children) == 1 \
+            and all(e.deterministic for e in _member_exprs(node))
+
+    def _rewrite(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
+        if self._fusable(plan):
+            chain = [plan]  # top-of-segment first
+            while len(chain) < self.max_members and \
+                    self._fusable(chain[-1].children[0]):
+                chain.append(chain[-1].children[0])
+            if len(chain) >= 2:
+                child = self._rewrite(chain[-1].children[0])
+                return TpuFusedSegmentExec(
+                    list(reversed(chain)), child,
+                    donate=self.donation and self._single_consumer(child))
+        children = [self._rewrite(c) for c in plan.children]
+        if children != list(plan.children):
+            plan = plan.with_new_children(children)
+        return plan
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _single_consumer(child) -> bool:
+        """Donation safety: the segment may donate its input buffers
+        only when the producer builds a FRESH batch per drain.  File
+        scans upload fresh every execution; LocalScan uploads are
+        cached on the exec and spill-registered (exec/transitions.py),
+        so a donated buffer would corrupt the next collect.  Everything
+        else (exchange reads, coalesce pass-through of catalog-held
+        batches) may retain references — stay conservative."""
+        return isinstance(child, HostToDeviceExec) and \
+            not isinstance(child.children[0], P.LocalScanExec)

@@ -76,6 +76,11 @@ class ExecContext:
             # follow the same per-query (re)arm discipline
             install_fault_injector(FaultInjector.from_conf(conf))
             _fault_stats.reset()
+        # kernel-cache counter snapshot: lets the session report
+        # per-query hits/misses/compile wall from the process-wide cache
+        from ..exec.kernel_cache import GLOBAL as _kernel_cache
+
+        self.kernel_cache_mark = _kernel_cache.counters()
 
 
 class PartitionedData:

@@ -24,6 +24,12 @@ class TpuTransitionOverrides:
 
     def apply(self, plan: P.PhysicalPlan) -> P.PhysicalPlan:
         plan = self._optimize_transitions(plan)
+        # fusion runs after transition cancellation (a cancelled
+        # D2H/H2D pair can join two row-local chains) and before
+        # coalesce insertion (goals then apply to whole segments)
+        from .fusion import TpuFusionPass
+
+        plan = TpuFusionPass(self.conf).apply(plan)
         plan = self._insert_coalesce(plan, goal=None)
         plan = self._optimize_coalesce(plan)
         if isinstance(plan, TpuExec):

@@ -86,6 +86,11 @@ class Session:
             self.device_manager = DeviceManager.get_or_create(self.conf)
             self.spill_framework = install_spill(self.device_manager,
                                                  self.conf)
+            # the shared kernel cache is process-wide (like the device
+            # manager); each device session (re)applies its sizing conf
+            from .exec.kernel_cache import GLOBAL as _kernel_cache
+
+            _kernel_cache.configure(self.conf)
             # reusable broadcast artifacts (reference:
             # GpuBroadcastExchangeExec's broadcast variable, built once
             # and shared by every consumer)
@@ -183,6 +188,12 @@ class Session:
         DataFrame gets a freshly planned tree instead of sharing."""
         import threading
 
+        from .exec.kernel_cache import GLOBAL as _kernel_cache
+
+        # snapshot BEFORE planning: exec construction is where keyed
+        # kernels register (sharedKernels) and misses start compiling,
+        # and it belongs to this query's kernelCache.* delta
+        kc_mark = _kernel_cache.counters()
         try:
             phys = self._plan_cache.get(plan)
         except TypeError:  # unhashable/unweakref-able plan
@@ -200,7 +211,9 @@ class Session:
                 pass
         if self.capture_plans:
             self._executed_plans.append(phys)
-        return phys, ExecContext(self.conf, self)
+        ctx = ExecContext(self.conf, self)
+        ctx.kernel_cache_mark = kc_mark
+        return phys, ctx
 
     def execute(self, plan: L.LogicalPlan) -> HostBatch:
         """Execute with the graceful-degradation ladder: when the
@@ -245,6 +258,10 @@ class Session:
             merged.update(preserve)
         if self.device_manager is not None:
             merged.update(_fault_stats.snapshot())
+            from .exec.kernel_cache import GLOBAL as _kernel_cache
+
+            merged.update(_kernel_cache.metrics_since(
+                getattr(ctx, "kernel_cache_mark", None)))
             fsum = fault_summary(merged)
             if fsum:
                 log.warning(

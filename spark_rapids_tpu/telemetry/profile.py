@@ -141,6 +141,19 @@ class QueryProfile:
             for name, wall, rows in hot:
                 lines.append(f"  {name}: {_fmt_ms(wall)} "
                              f"(rows={rows})")
+        kc = {k.split(".", 1)[1]: v for k, v in self.metrics.items()
+              if k.startswith("kernelCache.")}
+        if kc:
+            # kernelCache. is a counter family (lowercase prefix), so
+            # the per-exec grouping above skips it — render explicitly
+            disp = kc.get("dispatches", 0)
+            rate = f"{kc.get('hits', 0) / disp:.1%}" if disp else "n/a"
+            lines.append("")
+            lines.append(f"-- Kernel cache (hitRate={rate}) --")
+            for k in sorted(kc):
+                v = kc[k]
+                lines.append(f"  {k}: "
+                             + (_fmt_ms(v) if k.endswith("Ns") else str(v)))
         lines.append("")
         lines.append("-- Span tree --")
         self._render_span(self.span_tree(), 0, lines)

@@ -45,6 +45,9 @@ PEAK_DEVICE_MEMORY = "peakDevMemory"
 #: registered per exec only while telemetry is enabled, so the default
 #: metrics snapshot stays byte-identical to the un-instrumented engine
 DEVICE_SYNC_TIME = "deviceSyncTime"
+#: compile-inclusive wall of first-shape kernel dispatches, attributed
+#: to the dispatching exec by the KernelCache (exec/kernel_cache.py)
+COMPILE_TIME = "compileTime"
 
 # OOM retry framework (memory/retry.py; registered as "retry.<name>")
 NUM_RETRIES = "numRetries"

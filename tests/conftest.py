@@ -89,6 +89,18 @@ def _disarm_oom_injector():
 
 
 @pytest.fixture(autouse=True)
+def _reset_kernel_cache():
+    """The kernel cache is process-wide (like the device manager): a
+    test that shrinks maxEntries or disables it must not starve every
+    later test of kernel sharing, and counter assertions must start
+    from a clean slate."""
+    from spark_rapids_tpu.exec.kernel_cache import GLOBAL
+
+    GLOBAL.reset()
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _clear_telemetry_binding():
     """A query-telemetry binding (thread-local) must never outlive its
     test: a finished query's ring would silently collect the next

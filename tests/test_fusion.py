@@ -1,0 +1,306 @@
+"""Whole-stage fusion: plan-rewrite rules, bit-identity, dispatch
+counts.
+
+The fusion pass (plan/fusion.py) collapses maximal chains of row-local
+execs into one TpuFusedSegmentExec whose single jitted kernel threads
+the filter keep-mask through the segment and compacts once at exit.
+These tests pin the three contracts the optimisation rests on:
+
+1. **Rewrite rules** — what fuses, where segments stop (exchanges,
+   aggregates, sorts, joins, transitions, nondeterminism, the
+   maxSegmentExecs cap), and the clean round-trip with
+   ``fusion.enabled=false``.
+2. **Bit-identity** — fused vs unfused device plans produce EXACTLY
+   the same rows (same values, same order) across the TPC-H suite and
+   under fault/OOM injection.
+3. **Dispatch economics** — a Project→Filter→Project chain costs ONE
+   kernel dispatch per batch fused vs three unfused, counted through
+   the KernelCache telemetry.
+"""
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+from spark_rapids_tpu.exec.coalesce import TpuCoalesceBatchesExec
+from spark_rapids_tpu.exec.fused import TpuFusedSegmentExec
+from spark_rapids_tpu.plan import functions as F
+
+SF = 0.0007
+SEED = 7
+
+FUSED_OFF = {"spark.rapids.tpu.sql.fusion.enabled": False}
+
+
+def _walk(plan):
+    yield plan
+    for c in plan.children:
+        yield from _walk(c)
+
+
+def _segments(plan):
+    return [n for n in _walk(plan) if isinstance(n, TpuFusedSegmentExec)]
+
+
+def _collect_and_plan(sess, df):
+    sess.start_capture()
+    rows = df.collect()
+    return rows, sess.captured_plans()[-1]
+
+
+def _chain_df(sess):
+    """A Project -> Filter -> Project chain over two columns."""
+    df = sess.create_dataframe(
+        {"a": list(range(1, 41)), "b": [i * 10 for i in range(1, 41)]},
+        n_partitions=2)
+    return (df.select("a", "b", (F.col("a") + F.col("b")).alias("s"))
+            .filter(F.col("a") > 5)
+            .select("s"))
+
+
+# ==========================================================================
+# rewrite rules
+# ==========================================================================
+def test_project_filter_project_fuses_into_one_segment():
+    sess = srt.Session()
+    rows, plan = _collect_and_plan(sess, _chain_df(sess))
+    segs = _segments(plan)
+    assert len(segs) == 1, plan.tree_string()
+    assert len(segs[0].members) == 3
+    # EXPLAIN surface: the member list is visible in describe()
+    d = segs[0].describe()
+    assert "TpuFusedSegment[3:" in d
+    assert "TpuProject" in d and "TpuFilter" in d
+    assert rows == [(i + i * 10,) for i in range(6, 41)]
+
+
+def test_fusion_disabled_round_trips():
+    on = srt.Session()
+    off = srt.Session(dict(FUSED_OFF))
+    rows_on, plan_on = _collect_and_plan(on, _chain_df(on))
+    rows_off, plan_off = _collect_and_plan(off, _chain_df(off))
+    assert _segments(plan_on) and not _segments(plan_off)
+    assert rows_on == rows_off
+    oracle = _chain_df(srt.Session(tpu_enabled=False)).collect()
+    assert rows_on == oracle
+
+
+def test_single_row_local_exec_is_not_fused():
+    sess = srt.Session()
+    df = sess.create_dataframe({"a": [1, 2, 3]})
+    _, plan = _collect_and_plan(sess, df.select((F.col("a") * 2)
+                                                .alias("d")))
+    assert not _segments(plan)
+
+
+def test_segment_stops_at_aggregate_and_sort():
+    sess = srt.Session()
+    df = sess.create_dataframe(
+        {"k": [1, 2, 1, 2, 3] * 8, "v": list(range(40))})
+    q = (df.with_column("w", F.col("v") + 1)
+         .filter(F.col("w") > 3)
+         .group_by("k").agg(F.sum("w").alias("sw"))
+         .with_column("x", F.col("sw") * 2)
+         .filter(F.col("x") > 0)
+         .sort("k"))
+    rows, plan = _collect_and_plan(sess, q)
+    for seg in _segments(plan):
+        kinds = {type(m).__name__ for m in seg.members}
+        assert kinds <= {"TpuProjectExec", "TpuFilterExec",
+                         "TpuExpandExec", "TpuGenerateExec"}
+    # the aggregate and the sort are still standalone nodes
+    names = [type(n).__name__ for n in _walk(plan)]
+    assert "TpuHashAggregateExec" in names and "TpuSortExec" in names
+    oracle_sess = srt.Session(tpu_enabled=False)
+    odf = oracle_sess.create_dataframe(
+        {"k": [1, 2, 1, 2, 3] * 8, "v": list(range(40))})
+    oracle = (odf.with_column("w", F.col("v") + 1)
+              .filter(F.col("w") > 3)
+              .group_by("k").agg(F.sum("w").alias("sw"))
+              .with_column("x", F.col("sw") * 2)
+              .filter(F.col("x") > 0)
+              .sort("k")).collect()
+    assert rows == oracle
+
+
+def test_nondeterministic_exprs_break_the_segment():
+    """rand() is position-dependent: deferring the filter's compaction
+    would change which physical row feeds it — such projections must
+    not join a segment."""
+    sess = srt.Session()
+    df = sess.create_dataframe({"a": list(range(20))})
+    q = (df.filter(F.col("a") > 2)
+         .with_column("r", F.rand(42))
+         .filter(F.col("a") < 15))
+    _, plan = _collect_and_plan(sess, q)
+    for seg in _segments(plan):
+        for m in seg.members:
+            for e in getattr(m, "exprs", []):
+                assert e.deterministic, seg.describe()
+
+
+def test_max_segment_execs_caps_chain_length():
+    sess = srt.Session({"spark.rapids.tpu.sql.fusion.maxSegmentExecs": 2})
+    df = sess.create_dataframe({"a": list(range(30))})
+    q = (df.with_column("b", F.col("a") + 1)
+         .with_column("c", F.col("b") + 1)
+         .filter(F.col("c") > 4)
+         .with_column("d", F.col("c") * 2)
+         .select("d"))
+    rows, plan = _collect_and_plan(sess, q)
+    segs = _segments(plan)
+    assert segs, plan.tree_string()
+    assert all(len(s.members) <= 2 for s in segs)
+    oracle = srt.Session(dict(FUSED_OFF))
+    rows_off, _ = _collect_and_plan(
+        oracle,
+        (oracle.create_dataframe({"a": list(range(30))})
+         .with_column("b", F.col("a") + 1)
+         .with_column("c", F.col("b") + 1)
+         .filter(F.col("c") > 4)
+         .with_column("d", F.col("c") * 2)
+         .select("d")))
+    assert rows == rows_off
+
+
+def test_single_batch_goal_coalesce_lands_above_segment():
+    """A consumer with a children-coalesce goal (sort) must see its
+    coalesce between itself and the fused segment, exactly where the
+    unfused plan would put it (fusion runs before coalesce insertion)."""
+    sess = srt.Session()
+    df = sess.create_dataframe(
+        {"a": list(range(20))}, n_partitions=2)
+    q = (df.with_column("b", F.col("a") * 3)
+         .filter(F.col("b") > 6)
+         .sort_within_partitions("b"))
+    _, plan = _collect_and_plan(sess, q)
+    segs = _segments(plan)
+    assert segs
+    coalesces = [n for n in _walk(plan)
+                 if isinstance(n, TpuCoalesceBatchesExec)]
+    assert any(isinstance(c.children[0], TpuFusedSegmentExec)
+               for c in coalesces), plan.tree_string()
+
+
+def test_explode_generate_fuses_and_matches_oracle():
+    sess = srt.Session()
+    df = sess.create_dataframe({"a": [1, 2, 3, 4]})
+    q = (df.with_column("b", F.col("a") * 10)
+         .explode([F.col("a"), F.col("b")], name="e")
+         .filter(F.col("e") > 5))
+    rows, plan = _collect_and_plan(sess, q)
+    segs = _segments(plan)
+    assert segs and any(
+        type(m).__name__ == "TpuGenerateExec"
+        for s in segs for m in s.members), plan.tree_string()
+    oracle = (srt.Session(tpu_enabled=False)
+              .create_dataframe({"a": [1, 2, 3, 4]})
+              .with_column("b", F.col("a") * 10)
+              .explode([F.col("a"), F.col("b")], name="e")
+              .filter(F.col("e") > 5)).collect()
+    assert rows == oracle
+
+
+# ==========================================================================
+# dispatch economics (the acceptance criterion)
+# ==========================================================================
+def test_fused_chain_is_one_dispatch_per_batch():
+    """Project->Filter->Project over N single-batch partitions: the
+    fused plan issues exactly N kernel dispatches; the unfused plan
+    issues 3N (one per member per batch)."""
+    n_parts = 4
+    data = {"a": list(range(1, 81)), "b": [i * 2 for i in range(1, 81)]}
+
+    def run(conf):
+        sess = srt.Session(dict(conf))
+        df = sess.create_dataframe(data, n_partitions=n_parts)
+        q = (df.select("a", "b", (F.col("a") + F.col("b")).alias("s"))
+             .filter(F.col("a") > 10)
+             .select("s"))
+        rows = q.collect()
+        return rows, sess.last_metrics
+
+    rows_f, m_f = run({})
+    rows_u, m_u = run(FUSED_OFF)
+    assert rows_f == rows_u
+    assert m_f["kernelCache.dispatches"] == n_parts, m_f
+    assert m_u["kernelCache.dispatches"] == 3 * n_parts, m_u
+
+
+# ==========================================================================
+# TPC-H bit-identity (fused vs unfused device plans)
+# ==========================================================================
+def _tpch_rows(qnum, conf=None, tpu=True):
+    sess = srt.Session(dict(conf or {}), tpu_enabled=tpu)
+    tables = tpch_datagen.dataframes(sess, sf=SF, seed=SEED)
+    df = tpch.QUERIES[qnum](tables)
+    sess.start_capture()
+    rows = df.collect()
+    return rows, sess.captured_plans()[-1]
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 5, 6, 16])
+def test_tpch_fused_vs_unfused_bit_identical(qnum):
+    fused, plan_f = _tpch_rows(qnum)
+    unfused, plan_u = _tpch_rows(qnum, conf=FUSED_OFF)
+    # same rows, same order, same bits — compaction deferral must be
+    # invisible (exact ==, no float tolerance)
+    assert fused == unfused, f"q{qnum} diverged under fusion"
+    assert not _segments(plan_u)
+    # q1/q6 keep their single pre-aggregate filter (no >=2 chain);
+    # the scan-filter->project chains of q3/q5/q16 must fuse
+    if qnum in (3, 5, 16):
+        assert _segments(plan_f), f"q{qnum} produced no fused segment"
+
+
+@pytest.mark.fault_injection
+def test_tpch_q3_fused_bit_identical_under_corrupt_injection():
+    """Shuffle-payload corruption recovery re-executes the producing
+    stage from lineage — the fused plan must come out bit-identical to
+    its own injection-free run."""
+    conf = {
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+        "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+        "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "corrupt",
+        "spark.rapids.tpu.fault.injection.site": "exchange.write",
+        "spark.rapids.tpu.fault.injection.skipCount": 0,
+    }
+    clean, _ = _tpch_rows(3, conf={
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0})
+    injected, plan = _tpch_rows(3, conf=conf)
+    assert injected == clean
+    assert _segments(plan)
+
+
+@pytest.mark.oom_injection
+def test_tpch_q3_fused_bit_identical_under_oom_injection():
+    conf = {
+        "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+        "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+        "spark.rapids.tpu.memory.oomInjection.mode": "nth",
+        "spark.rapids.tpu.memory.oomInjection.skipCount": 1,
+        "spark.rapids.tpu.memory.oomInjection.oomType": "retry",
+    }
+    clean, _ = _tpch_rows(3)
+    injected, plan = _tpch_rows(3, conf=conf)
+    assert injected == clean
+    assert _segments(plan)
+
+
+# ==========================================================================
+# telemetry surfaces
+# ==========================================================================
+def test_profile_attributes_metrics_to_fused_segment():
+    sess = srt.Session({"spark.rapids.tpu.telemetry.enabled": True})
+    df = sess.create_dataframe(
+        {"a": list(range(1, 21)), "b": [i * 2 for i in range(1, 21)]})
+    (df.select("a", "b", (F.col("a") + F.col("b")).alias("s"))
+     .filter(F.col("a") > 3)
+     .select("s")).collect()
+    report = sess.profile_report()
+    assert "TpuFusedSegment" in report, report
+    assert "Kernel cache" in report and "hitRate" in report, report
+    m = sess.last_metrics
+    assert any(k.startswith("TpuFusedSegmentExec.") for k in m), m
+    assert m.get("kernelCache.dispatches", 0) >= 1, m

@@ -1,0 +1,60 @@
+"""AST lint: kernel-compilation discipline in ``exec/``.
+
+Every device exec must compile its kernels through the shared
+KernelCache (``jit_kernel``/``GLOBAL.get``) — a direct ``jax.jit``
+call site would dodge the cache's sharing, its hit/miss/compile-wall
+telemetry, and the donation gating, silently regressing the
+whole-stage-fusion economics.  Enforced mechanically like the
+telemetry emitter lint (tests/test_lint_telemetry.py).
+"""
+import ast
+import os
+
+EXEC_PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "spark_rapids_tpu", "exec")
+
+
+def _exec_files():
+    for fn in sorted(os.listdir(EXEC_PKG)):
+        if fn.endswith(".py"):
+            yield os.path.join(EXEC_PKG, fn)
+
+
+def _terminal_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def test_no_exec_calls_jit_directly():
+    offenders = []
+    for path in _exec_files():
+        if os.path.basename(path) == "kernel_cache.py":
+            continue  # the one place allowed to touch jax.jit
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) == "jit":
+                offenders.append(f"{path}:{node.lineno}")
+    assert not offenders, \
+        "direct jax.jit call in exec/ — compile through " \
+        f"exec.kernel_cache.jit_kernel instead: {offenders}"
+
+
+def test_kernel_cache_is_the_compile_path():
+    """Self-check: the migration actually happened — the exec package
+    routes a healthy number of kernel compilations through jit_kernel
+    (an empty scan would mean the lint above is watching nothing)."""
+    sites = 0
+    for path in _exec_files():
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) == "jit_kernel":
+                sites += 1
+    assert sites >= 10, \
+        f"only {sites} jit_kernel sites found in exec/ — migration " \
+        "regressed or the lint broke"
