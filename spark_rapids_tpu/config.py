@@ -133,8 +133,41 @@ def dump_markdown() -> str:
             continue
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
     lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC,
-              "", _OBSERVABILITY_DOC, "", _PERF_TUNING_DOC]
+              "", _SCHEDULING_DOC, "", _OBSERVABILITY_DOC, "",
+              _PERF_TUNING_DOC]
     return "\n".join(lines)
+
+
+_SCHEDULING_DOC = """\
+## Concurrent query scheduling
+
+The `scheduler.*` confs (table above) configure the concurrent query
+scheduler (`spark_rapids_tpu/scheduler/`, docs/scheduling.md):
+
+* **Admission control** — `Session.submit(plan)` returns a
+  `QueryHandle` (`result()` / `cancel()` / `status()`); at most
+  `scheduler.maxConcurrent` queries run at once, each holding an HBM
+  reservation of `scheduler.reservationFraction` x the DeviceManager
+  arena for its lifetime, and at most `scheduler.maxQueued` queries
+  wait in the bounded priority queue.  A submit beyond that bound — or
+  a queued query not dispatched within `scheduler.queueTimeoutMs` — is
+  shed with `QueryRejected` and an `admission_reject` event.
+* **Cooperative cancellation** — `handle.cancel()` and
+  `scheduler.queryTimeoutMs` deadlines trip the query's `CancelToken`;
+  every operator checkpoint the OOM/fault injectors reach polls it, so
+  the query unwinds with `TpuQueryCancelled` at its next allocation,
+  upload, drain or stage boundary: semaphore permits released,
+  spill/upload-cache buffers dropped, shuffle-catalog slots freed, a
+  terminal `query_cancelled` event emitted.
+* **Per-query failure isolation** — scheduled queries bind private
+  (thread-local) fault/OOM injectors instead of the process-wide
+  slots, and a query that exhausts its retry/ladder budget trips a
+  per-query circuit breaker to the CPU-exec plan without disarming or
+  degrading concurrent queries.
+* **Deterministic cancellation testing** — `fault.injection.type=
+  cancel` cancels the running query's token at any injector checkpoint
+  site, so mid-stage unwind is testable everywhere the injector
+  reaches."""
 
 
 _MEMORY_ROBUSTNESS_DOC = """\
@@ -328,7 +361,9 @@ FAULT_INJECTION_TYPE = conf("spark.rapids.tpu.fault.injection.type").doc(
     "Injected fault type: oom (typed retry OOM), corrupt (flip a byte "
     "in the next checksummed payload write so the read-side CRC32C "
     "verify must catch it), delay (sleep delayMs at the checkpoint — a "
-    "straggler), stage_crash (raise TpuStageCrash — a died stage)"
+    "straggler), stage_crash (raise TpuStageCrash — a died stage), "
+    "cancel (cancel the running query's CancelToken at the checkpoint "
+    "— deterministic mid-stage cancellation for unwind testing)"
 ).string_conf("oom")
 FAULT_INJECTION_SKIP_COUNT = conf(
     "spark.rapids.tpu.fault.injection.skipCount").doc(
@@ -389,6 +424,38 @@ FAULT_QUEUE_PUT_TIMEOUT_MS = conf(
     "persistently full queue past this deadline raises TpuStageTimeout "
     "(the consumer has died or wedged) instead of busy-looping "
     "silently (0 disables)").int_conf(180000)
+
+# --- concurrent query scheduler (scheduler/; reference: Theseus-style
+# admission + memory arbitration across concurrent queries) ----------------
+SCHEDULER_MAX_CONCURRENT = conf(
+    "spark.rapids.tpu.scheduler.maxConcurrent").doc(
+    "Queries the scheduler runs concurrently; further admitted queries "
+    "wait in the bounded priority queue until a slot AND an HBM "
+    "reservation are available").int_conf(2)
+SCHEDULER_MAX_QUEUED = conf("spark.rapids.tpu.scheduler.maxQueued").doc(
+    "Bound on queries waiting for a run slot; a submit beyond "
+    "maxConcurrent+maxQueued in-flight queries is shed immediately "
+    "(QueryRejected + an admission_reject event) — reject-or-queue "
+    "backpressure, never unbounded buffering").int_conf(16)
+SCHEDULER_QUEUE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.scheduler.queueTimeoutMs").doc(
+    "A queued query not dispatched within this many milliseconds is "
+    "shed with QueryRejected + an admission_reject event (0 waits "
+    "forever)").int_conf(30000)
+SCHEDULER_RESERVATION_FRACTION = conf(
+    "spark.rapids.tpu.scheduler.reservationFraction").doc(
+    "Fraction of the DeviceManager arena reserved per admitted query "
+    "for its lifetime; dispatch requires a free reservation, so the "
+    "sum of running reservations never exceeds the arena — the "
+    "admission-side HBM budget that keeps concurrent queries from "
+    "thrashing the spill path (0 disables reservations)"
+).double_conf(0.25)
+SCHEDULER_QUERY_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.scheduler.queryTimeoutMs").doc(
+    "Deadline on a running query, milliseconds, measured from "
+    "dispatch: past it the query's CancelToken trips and the query "
+    "unwinds cooperatively at its next operator checkpoint with "
+    "TpuQueryCancelled (0 disables)").int_conf(0)
 
 # --- scheduling -----------------------------------------------------------
 CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(

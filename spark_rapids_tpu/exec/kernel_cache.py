@@ -134,13 +134,17 @@ class KernelCache:
         from ..config import (KERNEL_CACHE_DONATION, KERNEL_CACHE_ENABLED,
                               KERNEL_CACHE_MAX_ENTRIES)
 
+        # read the conf outside the lock (conf getters can run user
+        # checkers), publish every field inside it: a concurrent get()
+        # must never observe a half-applied configuration
+        enabled = bool(conf.get(KERNEL_CACHE_ENABLED))
+        max_entries = max(1, int(conf.get(KERNEL_CACHE_MAX_ENTRIES)))
+        donation = bool(conf.get(KERNEL_CACHE_DONATION))
         with self._lock:
-            self.enabled = bool(conf.get(KERNEL_CACHE_ENABLED))
-            self.max_entries = max(1, int(
-                conf.get(KERNEL_CACHE_MAX_ENTRIES)))
+            self.enabled = enabled
+            self.max_entries = max_entries
+            self.donation_enabled = donation
             self._evict_locked()
-
-        self.donation_enabled = bool(conf.get(KERNEL_CACHE_DONATION))
 
     def reset(self) -> None:
         """Drop every entry and zero every counter (test isolation —
@@ -211,19 +215,29 @@ class KernelCache:
         finalizers free, e.g. HostToDeviceExec's cached upload buffers)
         for the life of the process."""
         use_key = None
-        if key is not None and self.enabled:
-            use_key = (key, tuple(static_argnums),
-                       tuple(donate_argnums), self.donation_active())
+        if key is not None:
+            # donation_active() probes the jax backend — keep it out of
+            # the lock; the enabled/donation pair is then re-read and
+            # applied atomically so a concurrent configure()/reset()
+            # never yields a key built from a half-applied config
+            donation = self.donation_active()
             with self._lock:
-                hit = self._entries.get(use_key)
-                if hit is not None:
-                    self._entries.move_to_end(use_key)
-                    self._counters["sharedKernels"] += 1
-                    return hit
+                if self.enabled:
+                    use_key = (key, tuple(static_argnums),
+                               tuple(donate_argnums),
+                               donation and self.donation_enabled)
+                    hit = self._entries.get(use_key)
+                    if hit is not None:
+                        self._entries.move_to_end(use_key)
+                        self._counters["sharedKernels"] += 1
+                        return hit
         kern = _CachedKernel(self, fn, static_argnums, donate_argnums)
         if use_key is not None:
             with self._lock:
-                self._entries.setdefault(use_key, kern)
+                # a concurrent thread may have registered the same key
+                # between our miss and here — the first registration
+                # wins and every caller shares it
+                kern = self._entries.setdefault(use_key, kern)
                 self._entries.move_to_end(use_key)
                 self._evict_locked()
         return kern

@@ -167,8 +167,14 @@ class TpuSortExec(TpuExec):
             fw.remove_batch(t.buf_id)
         carry = concat_device_batches(loaded) if len(loaded) > 1 \
             else loaded[0]
+        from ..scheduler.cancel import check_cancel
+
         active = list(range(len(runs)))
         while active:
+            # a k-way merge over spilled runs can drain for a long
+            # time between allocation checkpoints — poll cancellation
+            # once per emitted tile
+            check_cancel("sort.merge")
             # emit everything ordering <= the smallest active threshold
             k = self._argmin_run([heads[i] for i in active])
             r = active[k]

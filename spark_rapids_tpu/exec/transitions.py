@@ -51,8 +51,11 @@ def _bounded_put(q, item, stop, timeout_s: float) -> bool:
     import queue as _queue
     import time as _time
 
+    from ..scheduler.cancel import check_cancel
+
     deadline = (_time.monotonic() + timeout_s) if timeout_s > 0 else None
     while not stop.is_set():
+        check_cancel("h2d.prefetch")
         try:
             q.put(item, timeout=0.1)
             return True
@@ -73,7 +76,10 @@ def _next_prefetched(q, producer, err):
     forever on a dead producer."""
     import queue as _queue
 
+    from ..scheduler.cancel import check_cancel
+
     while True:
+        check_cancel("h2d.prefetch")
         try:
             return q.get(timeout=1.0)
         except _queue.Empty:
@@ -118,6 +124,23 @@ class HostToDeviceExec(TpuExec):
 
     def __init__(self, child):
         super().__init__([child])
+
+    def drop_cached_uploads(self) -> None:
+        """Unregister every cached upload (cancellation unwind): a
+        cancelled query must leave zero tracked device bytes behind,
+        and a cached upload is the one device artifact that outlives
+        its query by design.  The ``weakref.finalize`` hook stays armed
+        but finds the stores empty."""
+        caches = getattr(self, "_upload_caches", None)
+        if not caches:
+            return
+        from ..memory.spill import SpillFramework
+
+        fw = SpillFramework.get()
+        for store in caches.values():
+            _free_cached_uploads(fw, store)
+            store.clear()
+        caches.clear()
 
     @property
     def schema(self):
@@ -295,9 +318,12 @@ class HostToDeviceExec(TpuExec):
                 t = threading.Thread(
                     target=tspans.bound(tspans.capture(), produce),
                     daemon=True, name=f"h2d-prefetch-{pid}")
+                from ..scheduler.cancel import check_cancel
+
                 t.start()
                 try:
                     while True:
+                        check_cancel("h2d.consume")
                         try:
                             item = q.get_nowait()
                         except queue.Empty:

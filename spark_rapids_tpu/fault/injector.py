@@ -20,6 +20,10 @@ Fault types (``spark.rapids.tpu.fault.injection.type``):
   with a stage watchdog armed this trips ``fault.stageTimeoutMs``.
 * ``stage_crash`` — raise :class:`~.errors.TpuStageCrash` at the
   checkpoint (a died executor/stage).
+* ``cancel``      — cancel the current thread's
+  :class:`~..scheduler.cancel.CancelToken` (if bound) and raise
+  ``TpuQueryCancelled`` at the checkpoint, so deterministic mid-stage
+  cancellation is testable at every site the injector already reaches.
 
 Modes (``spark.rapids.tpu.fault.injection.mode``) are exactly PR-1's:
 ``none`` (off), ``nth`` (fire once at matching checkpoint #skipCount),
@@ -44,7 +48,7 @@ import threading
 import time
 from typing import Optional
 
-FAULT_TYPES = ("oom", "corrupt", "delay", "stage_crash")
+FAULT_TYPES = ("oom", "corrupt", "delay", "stage_crash", "cancel")
 
 # ==========================================================================
 # Injection-suppression scopes (moved from memory/retry.py; see module
@@ -223,14 +227,39 @@ class FaultInjector:
         if not self._decide(site):
             return
         if self.fault_type == "delay":
-            time.sleep(self.delay_ms / 1000.0)
-            return
+            # sliced sleep: a straggler whose attempt the stage
+            # watchdog has already abandoned must die with it, not
+            # linger for the full delay as an orphan thread
+            deadline = time.monotonic() + self.delay_ms / 1000.0
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                if attempt_abandoned():
+                    from .errors import TpuStageTimeout
+
+                    raise TpuStageTimeout(
+                        "injected delay cut short: the stage watchdog "
+                        "abandoned this attempt", site=site)
+                time.sleep(min(0.05, remaining))
         if self.fault_type == "stage_crash":
             from .errors import TpuStageCrash
 
             raise TpuStageCrash(
                 f"injected stage crash (mode={self.mode}, "
                 f"site={site or '?'})", site=site, injected=True)
+        if self.fault_type == "cancel":
+            from ..scheduler.cancel import TpuQueryCancelled
+            from ..scheduler.cancel import current as _current_token
+
+            token = _current_token()
+            if token is not None:
+                # every sibling task thread of this query stops at its
+                # own next checkpoint, not just the injected one
+                token.cancel(f"injected cancel (site={site or '?'})")
+            raise TpuQueryCancelled(
+                f"injected cancel (mode={self.mode}, "
+                f"site={site or '?'})")
         # fault_type == "oom"
         from ..memory.retry import TpuRetryOOM, TpuSplitAndRetryOOM
 
@@ -270,15 +299,54 @@ def get_fault_injector() -> Optional[FaultInjector]:
     return _fault_injector
 
 
+# ----- per-query scoped slot (thread-local) -------------------------------
+# A query running under the scheduler must not (re)install the PROCESS
+# level injector — that would poison concurrent queries.  Instead its
+# ExecContext creates a private injector and the scheduler worker binds
+# it thread-locally; the binding propagates to pool/watchdog/prefetch
+# threads through ``telemetry.spans.capture()``.  The funnels below
+# consult the scoped slot FIRST, so a scoped query never sees (and
+# never advances the counter of) the global injector.
+def bind_scoped_fault_injector(inj: Optional[FaultInjector]) -> None:
+    _tl.scoped_fault = inj
+
+
+def get_scoped_fault_injector() -> Optional[FaultInjector]:
+    return getattr(_tl, "scoped_fault", None)
+
+
+def bind_attempt_abandon(evt: Optional[threading.Event]) -> None:
+    """Bind the calling thread's abandoned-attempt flag.  The stage
+    watchdog (parallel/runner.py) sets the event when it gives up on an
+    attempt; long injected delays poll it so an orphaned straggler
+    thread terminates promptly instead of sleeping out its full delay
+    with no one left listening."""
+    _tl.attempt_abandon = evt
+
+
+def attempt_abandoned() -> bool:
+    evt = getattr(_tl, "attempt_abandon", None)
+    return evt is not None and evt.is_set()
+
+
 def maybe_inject_fault(site: str = "") -> None:
     """Fault checkpoint hook (raising/delaying types).  Wired at every
-    spill write/read, exchange step, stage boundary and leaf drain."""
-    inj = _fault_injector
+    spill write/read, exchange step, stage boundary and leaf drain.
+    Doubles as the cooperative-cancellation poll: the current thread's
+    ``CancelToken`` (if any) is checked before any injection."""
+    from ..scheduler.cancel import check_cancel
+
+    check_cancel(site)
+    inj = getattr(_tl, "scoped_fault", None)
+    if inj is None:
+        inj = _fault_injector
     if inj is not None:
         inj.check(site)
 
 
 def maybe_corrupt(site: str = "") -> bool:
     """Write-path corruption decision for checksummed boundaries."""
-    inj = _fault_injector
+    inj = getattr(_tl, "scoped_fault", None)
+    if inj is None:
+        inj = _fault_injector
     return inj is not None and inj.should_corrupt(site)

@@ -63,6 +63,7 @@ class DeviceManager:
         self._allocated = 0
         self._alloc_lock = threading.Lock()
         self._peak = 0
+        self._reserved = 0
         self.event_handler = None  # installed by spill framework
         if self.debug:
             log.info("DeviceManager: %s, arena=%d bytes",
@@ -152,6 +153,34 @@ class DeviceManager:
     def track_free(self, nbytes: int) -> None:
         with self._alloc_lock:
             self._allocated = max(0, self._allocated - nbytes)
+
+    # ----- admission-side reservations (scheduler) ------------------------
+    # A lifetime HBM reservation per *running* query: the scheduler only
+    # dispatches a query when its reservation fits, so the sum of
+    # running reservations never exceeds the arena.  Reservations are a
+    # dispatch gate, not an allocation — running queries' real
+    # allocations still flow through track_alloc against the full
+    # arena (the retry/spill machinery arbitrates inside the budget).
+    def try_reserve(self, nbytes: int) -> bool:
+        """Atomically reserve admission budget; False when it does not
+        fit (the caller keeps the query queued)."""
+        if nbytes <= 0:
+            return True
+        with self._alloc_lock:
+            if self._reserved + nbytes > self.arena_bytes:
+                return False
+            self._reserved += nbytes
+            return True
+
+    def release_reservation(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._alloc_lock:
+            self._reserved = max(0, self._reserved - nbytes)
+
+    @property
+    def reserved_bytes(self) -> int:
+        return self._reserved
 
     @property
     def allocated_bytes(self) -> int:

@@ -120,12 +120,46 @@ def get_injector() -> Optional[OomInjector]:
     return _injector
 
 
+# ----- per-query scoped slot (thread-local) -------------------------------
+# Mirrors fault.injector's scoped slot: scheduled queries get a private
+# injector bound to their worker threads instead of (re)installing the
+# process-wide one, so an oomInjection.* sweep on one query cannot
+# poison a concurrent neighbor.
+_scoped_tl = threading.local()
+
+
+def bind_scoped_injector(inj: Optional[OomInjector]) -> None:
+    _scoped_tl.injector = inj
+
+
+def get_scoped_injector() -> Optional[OomInjector]:
+    return getattr(_scoped_tl, "injector", None)
+
+
 def maybe_inject_oom(site: str = "", nbytes: int = 0) -> None:
     """Allocation checkpoint hook: called by ``DeviceManager.track_alloc``
-    and by the hot operators at the top of each retryable attempt."""
-    inj = _injector
+    and by the hot operators at the top of each retryable attempt.
+    Doubles as the cooperative-cancellation poll — a cancelled query
+    unwinds at its next allocation checkpoint."""
+    from ..scheduler.cancel import check_cancel
+
+    check_cancel(site)
+    inj = getattr(_scoped_tl, "injector", None)
+    if inj is None:
+        inj = _injector
     if inj is not None:
         inj.check(site)
+    # a generalized injector armed with the ``cancel`` fault must be
+    # reachable at allocation checkpoints too (the ISSUE contract:
+    # cancellation is testable everywhere the OOM injector reaches) —
+    # plans with no exchange/spill never pass a maybe_inject_fault site
+    from ..fault.injector import get_fault_injector, get_scoped_fault_injector
+
+    finj = get_scoped_fault_injector()
+    if finj is None:
+        finj = get_fault_injector()
+    if finj is not None and finj.fault_type == "cancel":
+        finj.check(site)
 
 
 # ==========================================================================

@@ -69,9 +69,15 @@ class DeviceSemaphore:
         import time
 
         if getattr(self._held, "count", 0) == 0:
+            from ..scheduler.cancel import check_cancel
+
             start = time.monotonic()
             while not self._sem.acquire(
                     timeout=min(self.acquire_timeout / 4, 10.0)):
+                # admission is a cancellation checkpoint: a cancelled
+                # query queued for the device must unwind now, not
+                # after winning a permit it will never use
+                check_cancel("semaphore.acquire")
                 progress = max(self._last_release, start)
                 if time.monotonic() - progress > self.acquire_timeout:
                     raise DeviceSemaphoreTimeout(

@@ -197,13 +197,22 @@ class MultiProcessRunner(DistributedRunner):
                                   name=f"mp-drain-{p}").start()
             deadline = (_time.monotonic() + deadline_ms / 1000.0
                         if deadline_ms > 0 else None)
+            from ..scheduler.cancel import check_cancel
+
             got = {}
             while len(got) < len(my_pids):
-                tmo = None if deadline is None else \
-                    max(0.0, deadline - _time.monotonic())
+                # bounded waits so a cancelled query's collector stops
+                # promptly instead of blocking on the box until every
+                # worker notices on its own
+                check_cancel("leaf.drain")
+                tmo = 0.25 if deadline is None else \
+                    max(0.0, min(0.25, deadline - _time.monotonic()))
                 try:
                     p, kind, val = box.get(timeout=tmo)
                 except _queue.Empty:
+                    if deadline is None \
+                            or _time.monotonic() < deadline:
+                        continue
                     from ..fault.errors import TpuStageTimeout
                     from ..fault.stats import GLOBAL as _fault_stats
                     from ..telemetry.events import emit_event

@@ -154,12 +154,21 @@ class DistributedRunner:
         import threading as _threading
 
         box: "_queue.Queue" = _queue.Queue(maxsize=1)
+        abandon = _threading.Event()
 
         def attempt():
+            from ..fault.injector import bind_attempt_abandon
+
+            # the abandon flag lets the watchdog reach INTO the
+            # attempt: injected delays poll it, so an abandoned
+            # straggler terminates instead of orphan-sleeping
+            bind_attempt_abandon(abandon)
             try:
                 box.put(("ok", fn()))
             except BaseException as e:  # noqa: BLE001
                 box.put(("err", e))
+            finally:
+                bind_attempt_abandon(None)
 
         # a daemon thread, NOT a ThreadPoolExecutor: futures workers
         # are joined at interpreter exit, so one abandoned hung attempt
@@ -173,6 +182,7 @@ class DistributedRunner:
         try:
             kind, val = box.get(timeout=timeout_ms / 1000.0)
         except _queue.Empty:
+            abandon.set()
             _fault_stats.add("numWatchdogTrips", 1)
             emit_event("watchdog_trip", site=what,
                        timeout_ms=timeout_ms)
@@ -1020,14 +1030,19 @@ class DistributedRunner:
         """Execute ``root`` distributed; collect to one HostBatch (rows
         of shard 0..n-1 concatenated in order)."""
         from ..data.column import register_pytrees
+        from ..scheduler.cancel import check_cancel
 
         register_pytrees()
         stages, leaves = self.plan_stages(root)
         env_stacked: Dict[str, DeviceBatch] = {}
         # leaves and stages each run under the bounded fault-recovery
         # protocol: watchdog deadline, typed-fault retry from lineage,
-        # exhaustion escalating to the degradation ladder
+        # exhaustion escalating to the degradation ladder.  A stage
+        # boundary is also a cancellation/deadline checkpoint — a
+        # cancelled or past-deadline query stops between stages instead
+        # of launching the next one.
         for leaf in leaves:
+            check_cancel(f"runner.leaf[{leaf.idx}]")
             with tspans.span(f"leaf[{leaf.idx}]", kind="stage",
                              node=leaf.node.name):
                 env_stacked[self._env_key(leaf)] = self._recover(
@@ -1036,6 +1051,7 @@ class DistributedRunner:
         caps: Dict = {}
         out = None
         for stage in stages:
+            check_cancel(f"runner.stage[{stage.sid}]")
             with tspans.span(f"stage[{stage.sid}]", kind="stage"):
                 out = self._recover(
                     lambda stage=stage: self._run_stage(

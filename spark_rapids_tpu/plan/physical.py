@@ -43,12 +43,24 @@ log = logging.getLogger(__name__)
 
 
 class ExecContext:
-    """Per-query execution context: conf, metrics, runtime services."""
+    """Per-query execution context: conf, metrics, runtime services.
 
-    def __init__(self, conf, session=None):
+    ``scheduled=True`` marks a query running under the concurrent
+    ``QueryScheduler``: its injectors are PRIVATE (bound thread-locally
+    on the creating worker thread and propagated via
+    ``telemetry.spans.capture()``) instead of (re)installed into the
+    process-wide slots, and the process-global fault counters are not
+    reset — one query's fault drill must not poison a concurrent
+    neighbor.  ``cancel_token`` is the query's cooperative-cancellation
+    token, bound to the creating thread the same way."""
+
+    def __init__(self, conf, session=None, *, scheduled: bool = False,
+                 cancel_token=None):
         self.conf = conf
         self.session = session
         self.metrics = MetricsRegistry()
+        self.scheduled = scheduled
+        self.cancel_token = cancel_token
         #: shuffle ids registered during this query, freed at query end
         #: (reference: per-shuffle cleanup, ShuffleBufferCatalog.scala)
         self.shuffle_ids: List[int] = []
@@ -60,6 +72,10 @@ class ExecContext:
             from ..telemetry.spans import QueryTelemetry
 
             self.telemetry = QueryTelemetry.begin(conf, session)
+        if cancel_token is not None:
+            from ..scheduler import cancel as _cancel
+
+            _cancel.activate(cancel_token)
         # (re)arm the OOM fault injector from this query's conf — per
         # query so an oomInjection.skipCount sweep restarts its
         # checkpoint counter every run (device sessions only; a host
@@ -67,15 +83,29 @@ class ExecContext:
         if session is not None and \
                 getattr(session, "device_manager", None) is not None:
             from ..fault.injector import (FaultInjector,
+                                          bind_scoped_fault_injector,
                                           install_fault_injector)
             from ..fault.stats import GLOBAL as _fault_stats
-            from ..memory.retry import OomInjector, install_injector
+            from ..memory.retry import (OomInjector,
+                                        bind_scoped_injector,
+                                        install_injector)
 
-            install_injector(OomInjector.from_conf(conf))
-            # the generalized fault injector + per-query fault counters
-            # follow the same per-query (re)arm discipline
-            install_fault_injector(FaultInjector.from_conf(conf))
-            _fault_stats.reset()
+            if scheduled:
+                # per-query failure isolation: private injectors bound
+                # to this worker thread (capture() propagates them);
+                # the process slots — and the global fault counters —
+                # belong to direct execute() callers
+                self.scoped_oom_injector = OomInjector.from_conf(conf)
+                self.scoped_fault_injector = \
+                    FaultInjector.from_conf(conf)
+                bind_scoped_injector(self.scoped_oom_injector)
+                bind_scoped_fault_injector(self.scoped_fault_injector)
+            else:
+                install_injector(OomInjector.from_conf(conf))
+                # the generalized fault injector + per-query fault
+                # counters follow the same per-query (re)arm discipline
+                install_fault_injector(FaultInjector.from_conf(conf))
+                _fault_stats.reset()
         # kernel-cache counter snapshot: lets the session report
         # per-query hits/misses/compile wall from the process-wide cache
         from ..exec.kernel_cache import GLOBAL as _kernel_cache
@@ -150,6 +180,7 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
         import time as _time
 
         from ..memory.retry import backoff_delay_s
+        from ..scheduler.cancel import TpuQueryCancelled
 
         for attempt in range(retries + 1):
             try:
@@ -157,6 +188,12 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
             except (KeyboardInterrupt, SystemExit):
                 raise
             except AssertionError:
+                raise
+            except TpuQueryCancelled:
+                # cancellation must terminate, not re-execute — but the
+                # task's permits still unwind
+                if sem is not None:
+                    sem.release_task()
                 raise
             except Exception:
                 if sem is not None:
@@ -177,9 +214,20 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
         raise AssertionError("retry loop must return or raise")
 
     if threads <= 1:
+        # the inline path runs tasks ON the calling thread, so the
+        # calling thread IS the task thread and must drop its device
+        # hold when the drain ends — without this, a scheduler worker
+        # draining a single-partition plan exits still holding a
+        # permit, and the pool loses it for the life of the process
+        # (the serial path masked it: the main thread idempotently
+        # re-acquires its own stale hold on the next query)
         batches = []
-        for pid in range(n):
-            batches.extend(drain_with_retry(pid))
+        try:
+            for pid in range(n):
+                batches.extend(drain_with_retry(pid))
+        finally:
+            if sem is not None:
+                sem.release_task()
     else:
         from concurrent.futures import ThreadPoolExecutor
 

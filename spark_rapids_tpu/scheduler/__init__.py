@@ -1,0 +1,27 @@
+"""Concurrent query scheduler: admission control, cooperative
+cancellation, deadlines, and per-query failure isolation.
+
+Import-light on purpose: ``fault/injector.py`` and ``memory/retry.py``
+import :mod:`.cancel` (stdlib-only) at module load to poll cancellation
+at every checkpoint; the heavier :mod:`.query_scheduler` is loaded
+lazily on first attribute access so the package never drags Session /
+config / telemetry into low-level import chains.
+"""
+from .cancel import (CancelToken, TpuQueryCancelled,  # noqa: F401
+                     check_cancel)
+
+_LAZY = ("QueryScheduler", "QueryHandle", "QueryRejected",
+         "QueryStatus")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import query_scheduler
+
+        return getattr(query_scheduler, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["CancelToken", "TpuQueryCancelled", "check_cancel",
+           *_LAZY]

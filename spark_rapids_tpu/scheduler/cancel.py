@@ -1,0 +1,133 @@
+"""Cooperative cancellation primitives.
+
+A :class:`CancelToken` is created per submitted query by the
+:class:`~spark_rapids_tpu.scheduler.query_scheduler.QueryScheduler` and
+threaded through ``ExecContext``.  It is *cooperative*: nothing is ever
+killed; instead every operator checkpoint the OOM/fault injectors
+already reach (``maybe_inject_oom`` / ``maybe_inject_fault``) first
+polls :func:`check_cancel`, so a cancelled or past-deadline query
+unwinds at the next allocation, upload, drain or stage boundary with an
+ordinary exception — :class:`TpuQueryCancelled` — that the retry
+machinery deliberately does **not** retry and the degradation ladder
+deliberately does **not** degrade.
+
+The token binding is thread-local (like the telemetry binding) and is
+propagated to worker threads through the extended
+``telemetry.spans.capture()`` tuple, so every existing pool / watchdog /
+prefetch spawn site carries it for free.
+
+Design note: cancellation is suppressed while the current thread is
+inside a retry *shield* (``fault.injector._shield_depth() > 0``) — the
+recovery machinery (suspend/spill/resume) must never be unwound halfway
+or permits and spill registrations would leak; the poll fires again at
+the next checkpoint outside the shield.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class TpuQueryCancelled(Exception):
+    """Raised at a cooperative checkpoint once the query's token is
+    cancelled (explicitly, by deadline, or by the ``cancel`` fault
+    type).
+
+    Deliberately **not** a ``TpuFaultError``: the fault-tolerance
+    ladder catches ``TpuFaultError`` to degrade a query to a lower
+    rung, but a cancelled query must terminate, not degrade.
+    """
+
+    def __init__(self, reason: str = "query cancelled"):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class CancelToken:
+    """Shared cancellation flag + optional monotonic deadline."""
+
+    def __init__(self, query_id: int = 0,
+                 deadline: Optional[float] = None):
+        self.query_id = query_id
+        #: absolute ``time.monotonic()`` deadline, or None
+        self.deadline = deadline
+        self._lock = threading.Lock()
+        self._cancelled = threading.Event()
+        self._reason: Optional[str] = None
+
+    # ----- state -----------------------------------------------------------
+    def cancel(self, reason: str = "query cancelled") -> bool:
+        """Mark the token cancelled; returns True on the first call."""
+        with self._lock:
+            if self._cancelled.is_set():
+                return False
+            self._reason = reason
+            self._cancelled.set()
+            return True
+
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    @property
+    def reason(self) -> Optional[str]:
+        return self._reason
+
+    def expired(self) -> bool:
+        return (self.deadline is not None
+                and time.monotonic() >= self.deadline)
+
+    # ----- cooperative checkpoint -----------------------------------------
+    def check(self, site: str = "") -> None:
+        """Raise :class:`TpuQueryCancelled` if cancelled or past the
+        deadline.  A deadline trip cancels the token first so every
+        sibling task thread of the query stops at its own next
+        checkpoint."""
+        if not self._cancelled.is_set():
+            if self.deadline is None or time.monotonic() < self.deadline:
+                return
+            self.cancel("query deadline exceeded")
+        reason = self._reason or "query cancelled"
+        if site:
+            raise TpuQueryCancelled(f"{reason} (at {site})")
+        raise TpuQueryCancelled(reason)
+
+
+# ---------------------------------------------------------------------------
+# thread-local binding (mirrors telemetry.spans activate/deactivate)
+# ---------------------------------------------------------------------------
+_tl = threading.local()
+
+
+def activate(token: Optional[CancelToken]) -> None:
+    """Bind *token* to the current thread (None unbinds)."""
+    _tl.token = token
+
+
+def deactivate() -> None:
+    _tl.token = None
+
+
+def current() -> Optional[CancelToken]:
+    return getattr(_tl, "token", None)
+
+
+def check_cancel(site: str = "") -> None:
+    """Poll the current thread's cancel token; no-op when unbound.
+
+    Called first thing by ``memory.retry.maybe_inject_oom`` and
+    ``fault.injector.maybe_inject_fault`` — i.e. at every operator
+    checkpoint — plus explicitly in the runner's stage loop and the
+    transition prefetch loops.  Suppressed inside a retry shield (see
+    module docstring)."""
+    token = getattr(_tl, "token", None)
+    if token is None:
+        return
+    if not token.cancelled() and not token.expired():
+        return
+    # Lazy import: fault.injector imports this module at top level.
+    from ..fault.injector import _shield_depth
+
+    if _shield_depth() > 0:
+        return
+    token.check(site)

@@ -1,0 +1,520 @@
+"""QueryScheduler — bounded admission, dispatch, deadlines and
+per-query failure isolation for concurrent queries.
+
+Reference analogue: the admission/memory-arbitration layer Theseus-
+style accelerator engines put in front of scarce device memory (see
+PAPERS.md) — here built on the existing DeviceManager budget, retry
+framework, degradation ladder and telemetry events.
+
+Model:
+
+* ``Session.submit(plan)`` -> :class:`QueryHandle` — at most
+  ``scheduler.maxConcurrent`` queries run concurrently (one daemon
+  worker thread each), at most ``scheduler.maxQueued`` wait in the
+  bounded priority queue; a submit past the bound — or a queued query
+  not dispatched within ``scheduler.queueTimeoutMs`` — is shed with
+  :class:`QueryRejected` plus an ``admission_reject`` event.
+* Each dispatched query holds an HBM *reservation* of
+  ``scheduler.reservationFraction`` x the DeviceManager arena for its
+  lifetime (``DeviceManager.try_reserve``): dispatch waits until the
+  reservation fits, so the sum of running reservations never exceeds
+  the arena.  When nothing is running the head query dispatches even
+  if its reservation cannot be charged — forward progress is never
+  reservation-deadlocked.
+* Cancellation is cooperative: ``handle.cancel()`` (or the
+  ``scheduler.queryTimeoutMs`` deadline, or an injected ``cancel``
+  fault) trips the query's :class:`~.cancel.CancelToken`; every
+  operator checkpoint polls it, and the worker unwinds — semaphore
+  permits released, upload caches dropped, shuffle slots freed by the
+  normal query-end path, a terminal ``query_cancelled`` event emitted.
+* Per-query failure isolation: scheduled queries run with PRIVATE
+  fault/OOM injectors (thread-local, see ``ExecContext``), and a query
+  that exhausts its retry/ladder budget trips a per-query circuit
+  breaker onto the CPU-exec plan — without disarming the process-wide
+  injector slots or writing the global fault counters, so concurrent
+  queries stay on the TPU path unpoisoned.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+from .cancel import CancelToken, TpuQueryCancelled
+
+log = logging.getLogger(__name__)
+
+#: all live schedulers in the process — the test harness shuts them
+#: down between tests (conftest) so no scheduler thread outlives its
+#: test
+_LIVE: "weakref.WeakSet[QueryScheduler]" = weakref.WeakSet()
+
+
+def shutdown_all() -> None:
+    """Shut down every live scheduler (test-harness hook)."""
+    for sched in list(_LIVE):
+        try:
+            sched.shutdown()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+
+class QueryRejected(RuntimeError):
+    """The scheduler shed this query (queue full or queue timeout)."""
+
+
+class QueryStatus:
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+
+
+class QueryHandle:
+    """Caller-side handle of one submitted query."""
+
+    def __init__(self, scheduler: "QueryScheduler", query_id: int,
+                 plan, priority: int):
+        self._scheduler = scheduler
+        self.query_id = query_id
+        self.plan = plan
+        self.priority = priority
+        self.token = CancelToken(query_id)
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._status = QueryStatus.QUEUED
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._queued_at = time.monotonic()
+        #: per-query attribution (the session's last_metrics /
+        #: last_profile are last-writer-wins under concurrency)
+        self.metrics: Dict = {}
+        self.profile = None
+        #: "tpu" or "cpu" — which path produced the result (the
+        #: circuit-breaker rung)
+        self.exec_path: Optional[str] = None
+        self._ctx = None  # the native attempt's ExecContext
+
+    # ----- caller API ------------------------------------------------------
+    def result(self, timeout: Optional[float] = None):
+        """Block for the result; raises the query's terminal error
+        (``TpuQueryCancelled`` / ``QueryRejected`` / the failure)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.query_id} not done after {timeout}s "
+                f"(status={self.status()})")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def cancel(self, reason: str = "cancelled by caller") -> bool:
+        """Trip the query's cancel token; a queued query is removed
+        immediately, a running one unwinds at its next checkpoint.
+        Returns True on the first effective cancel."""
+        first = self.token.cancel(reason)
+        self._scheduler._on_cancel(self, reason)
+        return first
+
+    def status(self) -> str:
+        with self._lock:
+            return self._status
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def events(self) -> List[Dict]:
+        """This query's telemetry event ring (empty when telemetry was
+        disabled)."""
+        tele = getattr(self._ctx, "telemetry", None)
+        if tele is None or tele.events is None:
+            return []
+        return tele.events.snapshot()
+
+    # ----- scheduler-side transitions --------------------------------------
+    def _mark_running(self) -> None:
+        with self._lock:
+            if not self._done.is_set():
+                self._status = QueryStatus.RUNNING
+
+    def _finish(self, status: str, result=None,
+                error: Optional[BaseException] = None) -> bool:
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._status = status
+            self._result = result
+            self._error = error
+            self._done.set()
+            return True
+
+
+class QueryScheduler:
+    """One per Session (created lazily by ``Session.submit``); owns a
+    dispatcher thread plus one daemon worker thread per running
+    query."""
+
+    def __init__(self, session):
+        from ..config import (FAULT_DEGRADE_ENABLED,
+                              SCHEDULER_MAX_CONCURRENT,
+                              SCHEDULER_MAX_QUEUED,
+                              SCHEDULER_QUERY_TIMEOUT_MS,
+                              SCHEDULER_QUEUE_TIMEOUT_MS,
+                              SCHEDULER_RESERVATION_FRACTION)
+        from ..telemetry import spans as tspans
+
+        self.session = session
+        conf = session.conf
+        self.max_concurrent = max(1, conf.get(SCHEDULER_MAX_CONCURRENT))
+        self.max_queued = max(0, conf.get(SCHEDULER_MAX_QUEUED))
+        self.queue_timeout_ms = conf.get(SCHEDULER_QUEUE_TIMEOUT_MS)
+        self.query_timeout_ms = conf.get(SCHEDULER_QUERY_TIMEOUT_MS)
+        self._dm = session.device_manager
+        frac = conf.get(SCHEDULER_RESERVATION_FRACTION)
+        self.reservation_bytes = 0
+        if self._dm is not None and frac > 0:
+            self.reservation_bytes = min(
+                int(frac * self._dm.arena_bytes), self._dm.arena_bytes)
+        self._degrade_enabled = (self._dm is not None
+                                 and conf.get(FAULT_DEGRADE_ENABLED))
+        self._cv = threading.Condition()
+        self._heap: List = []  # (-priority, seq, handle)
+        self._seq = itertools.count()
+        self._next_qid = itertools.count(1)
+        self._n_active = 0
+        self._running: set = set()  # running QueryHandles
+        self._workers: set = set()  # live worker threads
+        self._shutdown = False
+        _LIVE.add(self)
+        # the dispatcher inherits the creator's (usually empty)
+        # execution binding via the telemetry capture() discipline
+        self._dispatcher = threading.Thread(
+            target=tspans.bound(tspans.capture(), self._dispatch_loop),
+            daemon=True, name="query-scheduler")
+        self._dispatcher.start()
+
+    # ----- submission ------------------------------------------------------
+    def submit(self, plan, priority: int = 0) -> QueryHandle:
+        from ..telemetry.events import emit_event
+
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("QueryScheduler is shut down")
+            if len(self._heap) >= self.max_queued \
+                    and self._n_active >= self.max_concurrent:
+                queued, running = len(self._heap), self._n_active
+                emit_event("admission_reject", source="scheduler",
+                           reason="queue_full", queued=queued,
+                           running=running,
+                           max_queued=self.max_queued,
+                           max_concurrent=self.max_concurrent)
+                raise QueryRejected(
+                    f"scheduler queue full ({running} running / "
+                    f"{queued} queued; maxConcurrent="
+                    f"{self.max_concurrent}, maxQueued="
+                    f"{self.max_queued})")
+            handle = QueryHandle(self, next(self._next_qid), plan,
+                                 priority)
+            heapq.heappush(self._heap,
+                           (-priority, next(self._seq), handle))
+            self._cv.notify_all()
+        return handle
+
+    # ----- caller-side cancel hook -----------------------------------------
+    def _on_cancel(self, handle: QueryHandle, reason: str) -> None:
+        """Remove a still-queued handle immediately; a running one
+        unwinds cooperatively at its next checkpoint."""
+        with self._cv:
+            before = len(self._heap)
+            self._heap = [e for e in self._heap if e[2] is not handle]
+            removed = len(self._heap) != before
+            if removed:
+                heapq.heapify(self._heap)
+                self._cv.notify_all()
+        if removed:
+            handle._finish(QueryStatus.CANCELLED,
+                           error=TpuQueryCancelled(reason))
+
+    # ----- dispatcher ------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        from ..telemetry import spans as tspans
+
+        while True:
+            with self._cv:
+                handle = reservation = None
+                while handle is None:
+                    if self._shutdown:
+                        return
+                    self._shed_expired_locked(time.monotonic())
+                    if self._heap \
+                            and self._n_active < self.max_concurrent:
+                        entry = heapq.heappop(self._heap)
+                        cand = entry[2]
+                        if cand._done.is_set():
+                            continue  # cancelled while queued
+                        reservation = self.reservation_bytes
+                        if reservation and not self._dm.try_reserve(
+                                reservation):
+                            if self._n_active == 0:
+                                # forward-progress guarantee: an empty
+                                # machine always runs the head query
+                                reservation = 0
+                            else:
+                                heapq.heappush(self._heap, entry)
+                                self._cv.wait(timeout=0.05)
+                                continue
+                        handle = cand
+                        continue
+                    self._cv.wait(timeout=self._wait_timeout_locked())
+                self._n_active += 1
+                self._running.add(handle)
+                handle._mark_running()
+                worker = threading.Thread(
+                    target=tspans.bound(tspans.capture(),
+                                        self._worker_main),
+                    args=(handle, reservation), daemon=True,
+                    name=f"query-worker-{handle.query_id}")
+                self._workers.add(worker)
+            worker.start()
+            # drop the frame locals before sleeping on the condition:
+            # a dispatcher idling between queries must not pin the last
+            # handle (and through it the query's result/context) after
+            # every caller reference is gone
+            del worker, handle, cand, entry
+
+    def _wait_timeout_locked(self) -> Optional[float]:
+        """How long the dispatcher may sleep: until the earliest
+        queued entry would exceed its queue timeout (None = until
+        notified)."""
+        if self.queue_timeout_ms <= 0 or not self._heap:
+            return None
+        now = time.monotonic()
+        horizon = self.queue_timeout_ms / 1000.0
+        earliest = min(e[2]._queued_at for e in self._heap)
+        return max(0.01, earliest + horizon - now)
+
+    def _shed_expired_locked(self, now: float) -> None:
+        if not self._heap:
+            return
+        horizon = (self.queue_timeout_ms / 1000.0
+                   if self.queue_timeout_ms > 0 else None)
+        keep = []
+        shed = []
+        for entry in self._heap:
+            h = entry[2]
+            if h._done.is_set():
+                continue  # cancelled while queued, already finished
+            if horizon is not None and now - h._queued_at >= horizon:
+                shed.append(h)
+            else:
+                keep.append(entry)
+        if len(keep) != len(self._heap):
+            self._heap = keep
+            heapq.heapify(self._heap)
+        for h in shed:
+            self._reject_queued(h, "queue_timeout")
+
+    def _reject_queued(self, handle: QueryHandle, why: str) -> None:
+        from ..telemetry.events import emit_event
+
+        emit_event("admission_reject", source="scheduler", reason=why,
+                   query_id=handle.query_id,
+                   queue_timeout_ms=self.queue_timeout_ms)
+        log.warning("query %d shed from the scheduler queue (%s)",
+                    handle.query_id, why)
+        handle._finish(QueryStatus.REJECTED, error=QueryRejected(
+            f"query {handle.query_id} shed: {why} (queueTimeoutMs="
+            f"{self.queue_timeout_ms})"))
+
+    # ----- worker ----------------------------------------------------------
+    def _worker_main(self, handle: QueryHandle,
+                     reservation: int) -> None:
+        from ..fault.errors import TpuFaultError
+        from ..fault.injector import bind_scoped_fault_injector
+        from ..memory.retry import bind_scoped_injector
+        from ..telemetry import spans as tspans
+        from . import cancel as _cancel
+
+        token = handle.token
+        if self.query_timeout_ms and self.query_timeout_ms > 0:
+            token.deadline = (time.monotonic()
+                              + self.query_timeout_ms / 1000.0)
+        _cancel.activate(token)
+        sink: Dict = {}
+        try:
+            try:
+                out = self.session._execute_native(
+                    handle.plan, scheduled=True, cancel_token=token,
+                    ctx_sink=sink)
+                handle.exec_path = "tpu"
+                self._attribute(handle, sink)
+                handle._finish(QueryStatus.FINISHED, result=out)
+            except TpuQueryCancelled as e:
+                self._unwind_cancelled(handle, sink, e)
+            except TpuFaultError as e:
+                if not self._degrade_enabled:
+                    self._attribute(handle, sink)
+                    handle._finish(QueryStatus.FAILED, error=e)
+                else:
+                    try:
+                        self._run_cpu_fallback(handle, e, sink)
+                    except TpuQueryCancelled as e2:
+                        self._unwind_cancelled(handle, sink, e2)
+        except BaseException as e:  # noqa: BLE001 — worker must not die silent
+            self._attribute(handle, sink)
+            handle._finish(QueryStatus.FAILED, error=e)
+        finally:
+            # the worker thread dies with the query, but unbinding
+            # keeps the thread-local discipline explicit
+            _cancel.deactivate()
+            bind_scoped_injector(None)
+            bind_scoped_fault_injector(None)
+            tspans.deactivate()
+            if self._dm is not None:
+                # any device hold still on this thread dies with it —
+                # the semaphore can never get a dead thread's permit
+                # back, so the worker's last act is to drop its own
+                self._dm.semaphore.release_task()
+            if reservation and self._dm is not None:
+                self._dm.release_reservation(reservation)
+            with self._cv:
+                self._n_active -= 1
+                self._running.discard(handle)
+                self._workers.discard(threading.current_thread())
+                self._cv.notify_all()
+
+    def _attribute(self, handle: QueryHandle, sink: Dict) -> None:
+        """Per-query metric/profile attribution from the attempt's own
+        ExecContext (stowed by ``Session._finalize_metrics``)."""
+        ctx = sink.get("ctx")
+        if ctx is None:
+            return
+        handle._ctx = ctx
+        handle.metrics = dict(getattr(ctx, "final_metrics", None)
+                              or ctx.metrics.snapshot())
+        handle.profile = getattr(ctx, "profile", None)
+
+    def _unwind_cancelled(self, handle: QueryHandle, sink: Dict,
+                          exc: TpuQueryCancelled) -> None:
+        """Terminal cancellation unwind.  The normal query-end path
+        (``_execute_native``'s finally) already finalized metrics,
+        released the plan's exec lock and freed this query's shuffle
+        slots; what remains query-scoped is the worker's own semaphore
+        permits and the plan's cached uploads."""
+        from ..telemetry.events import emit_event
+
+        # the query's telemetry binding is still on this thread, so
+        # the terminal event lands in ITS event ring
+        emit_event("query_cancelled", query_id=handle.query_id,
+                   reason=str(exc))
+        if self._dm is not None:
+            try:
+                self._dm.semaphore.release_task()
+            except Exception:  # noqa: BLE001 — unwind must not raise
+                pass
+        phys = sink.get("phys")
+        if phys is not None:
+            self._drop_upload_caches(phys)
+        self._attribute(handle, sink)
+        log.warning("query %d cancelled: %s", handle.query_id, exc)
+        # drop the traceback/context chain before stowing the error on
+        # the handle: cancellation is cooperative (the frames carry no
+        # diagnosis) and their locals would pin device batches past the
+        # zero-leak unwind contract
+        exc.__cause__ = None
+        exc.__context__ = None
+        handle._finish(QueryStatus.CANCELLED,
+                       error=exc.with_traceback(None))
+
+    def _drop_upload_caches(self, phys) -> None:
+        """Walk the physical tree dropping cached uploads — the one
+        device artifact designed to outlive its query must not outlive
+        a CANCELLED query (zero-leak unwind contract)."""
+        seen = set()
+        stack = [phys]
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            drop = getattr(node, "drop_cached_uploads", None)
+            if drop is not None:
+                try:
+                    drop()
+                except Exception:  # noqa: BLE001 — unwind must not raise
+                    pass
+            stack.extend(getattr(node, "children", ()) or ())
+
+    def _run_cpu_fallback(self, handle: QueryHandle, cause,
+                          sink: Dict) -> None:
+        """Per-query circuit breaker: re-execute THIS query on the
+        CPU-exec plan.  Unlike the direct-execute ladder rung this
+        must NOT disarm the process-wide injectors or write the global
+        fault counters — concurrent queries keep their TPU path and
+        their own failure budgets."""
+        from ..fault.stats import DEGRADE_CPU
+        from ..plan.overrides import cpu_exec_plan
+        from ..plan.physical import ExecContext, collect_batches
+        from ..telemetry.events import emit_event
+
+        emit_event("degrade", level=DEGRADE_CPU, rung="cpu",
+                   cause=type(cause).__name__, scheduled=True,
+                   query_id=handle.query_id)
+        log.warning(
+            "scheduled query %d exhausted fault recovery (%s: %s) — "
+            "circuit breaker tripped to the CPU-exec plan",
+            handle.query_id, type(cause).__name__, cause)
+        self._attribute(handle, sink)  # failed attempt's counters
+        prior = {k: v for k, v in (handle.metrics or {}).items()
+                 if k.startswith(("fault.", "retry."))}
+        sess = self.session
+        phys = cpu_exec_plan(sess.conf, handle.plan)
+        # session=None: a bare host context — no telemetry re-begin,
+        # no injector (re)install, no global stats writes
+        ctx = ExecContext(sess.conf, None)
+        data = phys.execute(ctx)
+        schema = phys.schema if len(phys.schema) else handle.plan.schema
+        out = collect_batches(data, schema, ctx)
+        merged = dict(ctx.metrics.snapshot())
+        merged.update(prior)
+        merged["fault.degradeLevel"] = DEGRADE_CPU
+        handle.metrics = merged
+        handle.exec_path = "cpu"
+        handle._finish(QueryStatus.FINISHED, result=out)
+
+    # ----- lifecycle -------------------------------------------------------
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Cancel queued + running queries, stop the dispatcher, and
+        join every scheduler thread."""
+        with self._cv:
+            already = self._shutdown
+            self._shutdown = True
+            queued = [e[2] for e in self._heap]
+            self._heap = []
+            running = list(self._running)
+            workers = list(self._workers)
+            self._cv.notify_all()
+        for h in queued:
+            h.token.cancel("scheduler shutdown")
+            h._finish(QueryStatus.CANCELLED,
+                      error=TpuQueryCancelled("scheduler shutdown"))
+        for h in running:
+            h.token.cancel("scheduler shutdown")
+        if not already:
+            self._dispatcher.join(timeout)
+        for t in workers:
+            t.join(timeout)
+
+    @property
+    def active_count(self) -> int:
+        return self._n_active
+
+    @property
+    def queued_count(self) -> int:
+        with self._cv:
+            return len(self._heap)

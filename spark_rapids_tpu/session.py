@@ -74,6 +74,12 @@ class Session:
         import weakref
 
         self._plan_cache = weakref.WeakKeyDictionary()
+        # concurrent query scheduler — created lazily on first submit()
+        # so plain execute() sessions never pay for its threads
+        import threading as _threading
+
+        self._scheduler = None
+        self._scheduler_lock = _threading.Lock()
         from .config import TRACE_ENABLED
         from .utils import tracing
 
@@ -178,7 +184,8 @@ class Session:
             phys = TpuTransitionOverrides(self.conf).apply(phys)
         return phys
 
-    def prepare_execution(self, plan: L.LogicalPlan):
+    def prepare_execution(self, plan: L.LogicalPlan, *,
+                          scheduled: bool = False, cancel_token=None):
         """Plan + capture + context — the shared front half of execute
         paths (incl. the ML columnar export).
 
@@ -211,7 +218,8 @@ class Session:
                 pass
         if self.capture_plans:
             self._executed_plans.append(phys)
-        ctx = ExecContext(self.conf, self)
+        ctx = ExecContext(self.conf, self, scheduled=scheduled,
+                          cancel_token=cancel_token)
         ctx.kernel_cache_mark = kc_mark
         return phys, ctx
 
@@ -257,7 +265,11 @@ class Session:
         if preserve:
             merged.update(preserve)
         if self.device_manager is not None:
-            merged.update(_fault_stats.snapshot())
+            if not getattr(ctx, "scheduled", False):
+                # scheduled queries never reset (or report) the
+                # process-global fault counters — a neighbor's fault
+                # drill must not leak into this query's metrics
+                merged.update(_fault_stats.snapshot())
             from .exec.kernel_cache import GLOBAL as _kernel_cache
 
             merged.update(_kernel_cache.metrics_since(
@@ -277,10 +289,20 @@ class Session:
                     "pressure: %s", self.last_retry_summary)
         from .telemetry import finish_query
 
-        finish_query(self, ctx, phys=phys, metrics=merged)
+        # per-query attribution for concurrent callers (QueryHandle):
+        # session.last_metrics/last_profile are last-writer-wins shared
+        # state, so the handle reads these instead
+        ctx.final_metrics = merged
+        ctx.profile = finish_query(self, ctx, phys=phys, metrics=merged)
 
-    def _execute_native(self, plan: L.LogicalPlan) -> HostBatch:
-        phys, ctx = self.prepare_execution(plan)
+    def _execute_native(self, plan: L.LogicalPlan, *,
+                        scheduled: bool = False, cancel_token=None,
+                        ctx_sink: Optional[Dict] = None) -> HostBatch:
+        phys, ctx = self.prepare_execution(
+            plan, scheduled=scheduled, cancel_token=cancel_token)
+        if ctx_sink is not None:
+            ctx_sink["phys"] = phys
+            ctx_sink["ctx"] = ctx
         try:
             data = phys.execute(ctx)
             schema = phys.schema if len(phys.schema) else plan.schema
@@ -340,6 +362,36 @@ class Session:
             # guard a stale prior-query profile would be corrupted.
             self.last_profile.metrics = dict(self.last_metrics)
         return out
+
+    # ----- concurrent submission (scheduler/) -------------------------------
+    @property
+    def scheduler(self):
+        """The session's QueryScheduler, created on first access."""
+        with self._scheduler_lock:
+            if self._scheduler is None:
+                from .scheduler.query_scheduler import QueryScheduler
+
+                self._scheduler = QueryScheduler(self)
+            return self._scheduler
+
+    def submit(self, plan, priority: int = 0):
+        """Submit a query (a DataFrame or logical plan) for concurrent
+        execution; returns a ``QueryHandle`` with ``result()`` /
+        ``cancel()`` / ``status()``.  Admission is bounded
+        (``scheduler.maxConcurrent`` running + ``scheduler.maxQueued``
+        queued); a submit past the bound raises ``QueryRejected`` and
+        emits an ``admission_reject`` event."""
+        if isinstance(plan, DataFrame):
+            plan = plan.plan
+        return self.scheduler.submit(plan, priority=priority)
+
+    def shutdown_scheduler(self) -> None:
+        """Stop the scheduler (cancelling queued + running queries) and
+        join its threads; a later submit() starts a fresh one."""
+        with self._scheduler_lock:
+            sched, self._scheduler = self._scheduler, None
+        if sched is not None:
+            sched.shutdown()
 
     def execute_columnar(self, plan: L.LogicalPlan):
         """Zero-copy device export: returns the list of DeviceBatches of

@@ -11,7 +11,10 @@ noteworthy engine transition emits one flat JSON record:
 ``watchdog_trip``    — a stage/leaf/drain deadline fired,
 ``stage_retry``      — a stage/leaf re-executed from lineage,
 ``degrade``          — the degradation ladder changed rungs,
-``admission_reject`` — the device arena refused an allocation,
+``admission_reject`` — the device arena refused an allocation, or the
+                       query scheduler shed a submit/queued query,
+``query_cancelled``  — a scheduled query terminated by cooperative
+                       cancellation (explicit, deadline, or injected),
 ``fault_injected``   — the deterministic injector fired (test mode).
 
 Emission contract: call sites OUTSIDE ``telemetry/`` must only use

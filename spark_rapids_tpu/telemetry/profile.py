@@ -83,7 +83,14 @@ class QueryProfile:
         self.root = tele.root
         self.events = tele.events
         self.metrics = dict(metrics)
-        self.plan = plan
+        # the annotated plan is rendered NOW, not at report time:
+        # retaining the live exec tree would pin everything its GC
+        # finalizers release (HostToDeviceExec's cached uploads,
+        # spill-registered buffers) for as long as the session's
+        # profile ring holds this profile — a finished query must not
+        # hold device memory
+        self.plan_text = (explain_analyze(plan, self.metrics)
+                          if plan is not None else None)
         self.hbm_timeline = list(tele.hbm_timeline)
 
     # ------------------------------------------------------------------
@@ -130,10 +137,10 @@ class QueryProfile:
         """The full EXPLAIN-ANALYZE report."""
         lines = [f"== Query profile {self.query_id} "
                  f"(wall={_fmt_ms(self.wall_ns)}) =="]
-        if self.plan is not None:
+        if self.plan_text is not None:
             lines.append("")
             lines.append("-- Physical plan (annotated) --")
-            lines.append(explain_analyze(self.plan, self.metrics))
+            lines.append(self.plan_text)
         hot = hot_operators(self.metrics, top_n)
         if hot:
             lines.append("")

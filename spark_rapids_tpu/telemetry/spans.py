@@ -255,37 +255,65 @@ def current_span() -> Optional[Span]:
 
 # ----- worker-thread propagation ------------------------------------------
 def capture():
-    """Capture the caller's telemetry binding for a worker thread
-    (None when telemetry is inactive — attach is then a no-op).  Every
-    thread-spawn site in the package must call this BEFORE spawning
-    and bind the worker body with :func:`attached`/:func:`bound`."""
+    """Capture the caller's per-query execution binding for a worker
+    thread: the telemetry binding PLUS the scheduler's cancel token
+    and per-query scoped fault/OOM injectors (all thread-local), so
+    every pool/watchdog/prefetch spawn site propagates cancellation
+    and failure isolation for free.  Returns None when nothing is
+    bound — attach is then a no-op.  Every thread-spawn site in the
+    package must call this BEFORE spawning and bind the worker body
+    with :func:`attached`/:func:`bound`."""
+    from ..fault import injector as _finj
+    from ..memory import retry as _retry
+    from ..scheduler import cancel as _cancel
+
     tele = current()
-    if tele is None:
+    token = _cancel.current()
+    oom_inj = _retry.get_scoped_injector()
+    fault_inj = _finj.get_scoped_fault_injector()
+    if tele is None and token is None and oom_inj is None \
+            and fault_inj is None:
         return None
-    return (tele, current_span())
+    parent = current_span() if tele is not None else None
+    return (tele, parent, token, oom_inj, fault_inj)
 
 
 @contextmanager
 def attached(cap):
-    """Bind a captured telemetry context to the current (worker)
+    """Bind a captured execution context to the current (worker)
     thread for the duration of the block; restores the previous
     binding on exit (re-entrant)."""
     if cap is None:
         yield
         return
-    tele, parent = cap
+    from ..fault import injector as _finj
+    from ..memory import retry as _retry
+    from ..scheduler import cancel as _cancel
+
+    tele, parent, token, oom_inj, fault_inj = cap
     prev_t = getattr(_tl, "telemetry", None)
     prev_s = getattr(_tl, "stack", None)
     prev_r = getattr(_tl, "ranges", None)
-    _tl.telemetry = tele
-    _tl.stack = [parent or tele.root]
-    _tl.ranges = []
+    prev_tok = _cancel.current()
+    prev_oom = _retry.get_scoped_injector()
+    prev_flt = _finj.get_scoped_fault_injector()
+    if tele is not None:
+        _tl.telemetry = tele
+        _tl.stack = [parent or tele.root]
+        _tl.ranges = []
+    _cancel.activate(token)
+    _retry.bind_scoped_injector(oom_inj)
+    _finj.bind_scoped_fault_injector(fault_inj)
     try:
         yield
     finally:
-        _tl.telemetry = prev_t
-        _tl.stack = prev_s
-        _tl.ranges = prev_r
+        if tele is not None:
+            _tl.telemetry = prev_t
+            _tl.stack = prev_s
+            _tl.ranges = prev_r
+        _cancel.activate(prev_tok)
+        _retry.bind_scoped_injector(prev_oom)
+        _finj.bind_scoped_fault_injector(prev_flt)
 
 
 def bound(cap, fn):

@@ -89,6 +89,51 @@ def _disarm_oom_injector():
 
 
 @pytest.fixture(autouse=True)
+def _shutdown_query_schedulers():
+    """Mirror of the injector-disarm fixture for the concurrent query
+    scheduler: every scheduler created during a test is shut down
+    (cancelling its queued/running queries) and its threads joined, so
+    no scheduler/worker thread — and no thread-local cancel-token or
+    scoped-injector binding on the main thread — outlives its test."""
+    yield
+    import threading
+
+    from spark_rapids_tpu.fault.injector import \
+        bind_scoped_fault_injector
+    from spark_rapids_tpu.memory.retry import bind_scoped_injector
+    from spark_rapids_tpu.scheduler import cancel as _cancel
+    from spark_rapids_tpu.scheduler import query_scheduler as _qs
+
+    _qs.shutdown_all()
+    _cancel.deactivate()
+    bind_scoped_injector(None)
+    bind_scoped_fault_injector(None)
+    leaked = [t.name for t in threading.enumerate()
+              if t.is_alive() and t is not threading.current_thread()
+              and (t.name.startswith("query-scheduler")
+                   or t.name.startswith("query-worker"))]
+    assert not leaked, \
+        f"scheduler threads leaked across the test boundary: {leaked}"
+    # stage-watchdog attempt threads may legitimately outlive a
+    # tripped watchdog briefly (they drain with the abandoned
+    # attempt); give them a bounded join so they cannot pile up
+    # across tests, then assert they actually drained
+    stragglers = [t for t in threading.enumerate()
+                  if t.is_alive() and t.name == "stage-watchdog"]
+    deadline = 10.0
+    for t in stragglers:
+        import time as _time
+
+        t0 = _time.monotonic()
+        t.join(deadline)
+        deadline = max(0.1, deadline - (_time.monotonic() - t0))
+    leaked_wd = [t.name for t in stragglers if t.is_alive()]
+    assert not leaked_wd, \
+        "stage-watchdog threads still running after the test " \
+        f"boundary grace period: {len(leaked_wd)} thread(s)"
+
+
+@pytest.fixture(autouse=True)
 def _reset_kernel_cache():
     """The kernel cache is process-wide (like the device manager): a
     test that shrinks maxEntries or disables it must not starve every

@@ -253,3 +253,75 @@ def test_identical_execs_across_sessions_share_kernels():
 
     assert run() == run()
     assert GLOBAL.counters()["sharedKernels"] >= 1
+
+
+# ==========================================================================
+# thread-safety (concurrent scheduler workers share the process cache)
+# ==========================================================================
+def test_concurrent_get_configure_reset_hammer():
+    """Many threads racing get()/configure()/reset()/counters() must
+    never corrupt the registry: every caller of a shared key in a
+    stable window gets a working kernel, entry count respects
+    maxEntries, counters stay non-negative, and no thread raises.
+    This is the regression test for the scheduler's worker threads all
+    dispatching through GLOBAL at once."""
+    import threading
+
+    errors = []
+    stop = threading.Event()
+    barrier = threading.Barrier(12)
+    x = jnp.arange(8)
+
+    def dispatcher(tid):
+        barrier.wait()
+        i = 0
+        while not stop.is_set():
+            # a small rotating key set forces constant hit/miss/evict
+            # traffic through the same buckets
+            key = ("hammer", i % 5)
+            k = jit_kernel(_add_one, key=key)
+            out = k(x)
+            assert int(out[0]) == 1
+            i += 1
+
+    def configurer():
+        barrier.wait()
+        flip = False
+        while not stop.is_set():
+            GLOBAL.configure(_conf(enabled=True,
+                                   maxEntries=2 if flip else 64))
+            flip = not flip
+
+    def resetter():
+        barrier.wait()
+        while not stop.is_set():
+            GLOBAL.reset()
+            GLOBAL.counters()
+            _ = GLOBAL.num_entries
+
+    def run(fn, *args):
+        try:
+            fn(*args)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+            stop.set()
+
+    threads = ([threading.Thread(target=run, args=(dispatcher, t))
+                for t in range(10)]
+               + [threading.Thread(target=run, args=(configurer,)),
+                  threading.Thread(target=run, args=(resetter,))])
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "hammer thread wedged"
+    assert not errors, errors[0]
+    # post-race invariants: a coherent registry and sane counters
+    c = GLOBAL.counters()
+    assert all(v >= 0 for v in c.values()), c
+    GLOBAL.configure(_conf(enabled=True, maxEntries=2))
+    assert GLOBAL.num_entries <= 2

@@ -1,0 +1,142 @@
+"""AST lint: cooperative-cancellation and scheduler-thread discipline.
+
+The scheduler's cancellation model is COOPERATIVE: a cancelled query
+stops because its operator loops poll the cancel token, not because
+anything preempts them.  That property is only as strong as the least
+compliant loop, so it is enforced mechanically:
+
+1. **Drain loops poll** — in the operator layers a cancelled query
+   flows through (``exec/``, ``parallel/runner.py``,
+   ``parallel/multiprocess.py``), every infinite loop (``while True``)
+   and every queue-draining loop (a ``while`` whose body blocks on
+   ``.get(...)``/``.put(...)``) must call one of the cancellation/
+   injection checkpoints (``check_cancel`` / ``maybe_inject_fault`` /
+   ``maybe_inject_oom``) each iteration, or appear in the explicit
+   allowlist below with a reason.
+2. **Scheduler threads capture context** — every ``Thread`` spawned in
+   ``scheduler/`` must wrap its target with the telemetry ``capture``/
+   ``bound`` binding (thread-locals do not cross spawns), and the
+   worker body must both bind AND unbind the per-query cancel token
+   (an activate without a deactivate leaks the token onto a pooled
+   thread's next query).
+"""
+import ast
+import os
+
+PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "spark_rapids_tpu")
+
+#: the operator layers a running query's control flow lives in
+SCOPE_DIRS = ("exec",)
+SCOPE_FILES = (os.path.join("parallel", "runner.py"),
+               os.path.join("parallel", "multiprocess.py"))
+
+POLL_NAMES = {"check_cancel", "maybe_inject_fault", "maybe_inject_oom"}
+CAPTURE_NAMES = {"capture", "bound", "attached"}
+
+#: "<relpath>:<lineno>" -> reason.  Keep this SHORT — an entry here is
+#: a loop a cancelled query can wedge in.
+ALLOWLIST = {}
+
+
+def _scope_files():
+    for d in SCOPE_DIRS:
+        base = os.path.join(PKG, d)
+        for root, _dirs, files in os.walk(base):
+            for fn in files:
+                if fn.endswith(".py"):
+                    yield os.path.join(root, fn)
+    for rel in SCOPE_FILES:
+        yield os.path.join(PKG, rel)
+
+
+def _terminal_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _calls_in(node):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield _terminal_name(n.func)
+
+
+def _is_drain_loop(loop: ast.While) -> bool:
+    """Infinite, or blocking on queue traffic in the body."""
+    if isinstance(loop.test, ast.Constant) and loop.test.value is True:
+        return True
+    return any(name in ("get", "put") for name in _calls_in(loop))
+
+
+def test_every_drain_loop_polls_a_cancellation_checkpoint():
+    offenders, checked = [], 0
+    for path in _scope_files():
+        rel = os.path.relpath(path, PKG)
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.While) \
+                    or not _is_drain_loop(node):
+                continue
+            checked += 1
+            if f"{rel}:{node.lineno}" in ALLOWLIST:
+                continue
+            if not any(n in POLL_NAMES for n in _calls_in(node)):
+                offenders.append(f"{rel}:{node.lineno}")
+    # transitions.py's prefetch loops alone guarantee a non-empty scan
+    assert checked >= 3, \
+        f"drain-loop scan found only {checked} loops — lint broken?"
+    assert not offenders, \
+        "drain loops without a cancellation checkpoint (add " \
+        "check_cancel(site) per iteration, or allowlist with a " \
+        f"reason): {offenders}"
+
+
+def _scheduler_tree(name="query_scheduler.py"):
+    path = os.path.join(PKG, "scheduler", name)
+    return path, ast.parse(open(path).read(), filename=path)
+
+
+def test_scheduler_thread_spawns_capture_telemetry_binding():
+    offenders, spawns = [], 0
+    for fn in os.listdir(os.path.join(PKG, "scheduler")):
+        if not fn.endswith(".py"):
+            continue
+        path, tree = _scheduler_tree(fn)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) == "Thread":
+                spawns += 1
+                names = set(_calls_in(node))
+                if not names & CAPTURE_NAMES:
+                    offenders.append(f"{fn}:{node.lineno}")
+    assert spawns >= 2, \
+        "scheduler spawns dispatcher + worker threads — scan broken?"
+    assert not offenders, \
+        "scheduler Thread spawns missing the telemetry capture()/" \
+        f"bound() wrapping: {offenders}"
+
+
+def test_worker_binds_and_unbinds_the_cancel_token():
+    """``_worker_main`` must activate the query's cancel token (and
+    scoped injectors) before executing, and deactivate/unbind them in a
+    ``finally`` — a leaked binding would cancel or fault-inject the
+    NEXT query that runs on the thread."""
+    _path, tree = _scheduler_tree()
+    worker = next(n for n in ast.walk(tree)
+                  if isinstance(n, ast.FunctionDef)
+                  and n.name == "_worker_main")
+    calls = set(_calls_in(worker))
+    assert "activate" in calls, \
+        "_worker_main must bind the cancel token via cancel.activate"
+    finals = [n for t in ast.walk(worker) if isinstance(t, ast.Try)
+              for n in t.finalbody]
+    final_calls = {name for f in finals for name in _calls_in(f)}
+    assert "deactivate" in final_calls, \
+        "_worker_main must deactivate the cancel token in a finally"
+    assert "bind_scoped_injector" in final_calls \
+        and "bind_scoped_fault_injector" in final_calls, \
+        "_worker_main must unbind the scoped injectors in a finally"

@@ -1,0 +1,421 @@
+"""Concurrent query scheduler (spark_rapids_tpu/scheduler/).
+
+The contracts under test:
+
+* **Admission** — at most ``scheduler.maxConcurrent`` queries run, at
+  most ``scheduler.maxQueued`` wait; a submit past the bound (or a
+  queued query past ``scheduler.queueTimeoutMs``) is shed with
+  :class:`QueryRejected` plus an ``admission_reject`` event.
+* **Correctness under concurrency** — queries submitted through
+  ``Session.submit`` return results bit-identical to serial
+  ``collect()``, including under deterministic corrupt/OOM injection,
+  with per-query metrics/profiles attributed to the right handle.
+* **Cooperative cancellation** — ``handle.cancel()``, the
+  ``scheduler.queryTimeoutMs`` deadline, and the injected ``cancel``
+  fault all unwind the query with ZERO leaked device bytes, semaphore
+  permits, HBM reservations or shuffle-catalog slots, and a terminal
+  ``query_cancelled`` event.
+* **Per-query failure isolation** — a query that exhausts its fault
+  budget trips its own circuit breaker onto the CPU-exec plan without
+  degrading concurrent queries or writing the global fault counters.
+"""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.scheduler import (QueryRejected, TpuQueryCancelled,
+                                        check_cancel)
+from spark_rapids_tpu.scheduler.cancel import CancelToken
+from spark_rapids_tpu.scheduler.query_scheduler import QueryStatus
+
+#: fast-recovery confs shared by injection tests (CI must not sleep
+#: through its budget; the backoff code is real either way)
+FAST = {
+    "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+    "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+}
+
+#: force real exchanges (no broadcast shortcut) so injection sites and
+#: shuffle-slot accounting are exercised
+SHUFFLED = {"spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+            "spark.rapids.tpu.sql.taskRetries": 3}
+
+
+def _inject(mode, fault_type, site="", skip=0, delay_ms=50.0, **extra):
+    conf = dict(FAST)
+    conf.update({
+        "spark.rapids.tpu.fault.injection.mode": mode,
+        "spark.rapids.tpu.fault.injection.type": fault_type,
+        "spark.rapids.tpu.fault.injection.site": site,
+        "spark.rapids.tpu.fault.injection.skipCount": skip,
+        "spark.rapids.tpu.fault.injection.delayMs": delay_ms,
+    })
+    conf.update(extra)
+    return conf
+
+
+def _norm(rows):
+    return sorted(
+        (tuple((None if v is None else
+                (round(v, 9) if isinstance(v, float) else v))
+               for v in r) for r in rows),
+        key=repr)
+
+
+def _join_agg_df(sess):
+    rng = np.random.RandomState(3)
+    orders = {"o_custkey": rng.randint(0, 40, 300).tolist(),
+              "o_total": [round(float(v), 6)
+                          for v in rng.rand(300) * 1000]}
+    cust = {"c_custkey": list(range(40)),
+            "c_nation": rng.randint(0, 5, 40).tolist()}
+    o = sess.create_dataframe(orders)
+    c = sess.create_dataframe(cust)
+    j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+    return j.group_by("c_nation").agg(
+        F.sum("o_total").alias("rev"), F.count("o_total").alias("n"))
+
+
+def _select_df(sess):
+    return sess.create_dataframe(
+        {"a": list(range(64)), "b": [i * 2 for i in range(64)]}
+    ).select("a")
+
+
+def _wait_until(pred, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for: {msg}")
+
+
+def _available_permits(sem) -> int:
+    """Drain the underlying semaphore non-blocking to count available
+    permits (then put them back) — ``held_count`` is thread-local, so a
+    leak by a dead worker thread is only visible here."""
+    got = 0
+    while sem._sem.acquire(blocking=False):
+        got += 1
+    for _ in range(got):
+        sem._sem.release()
+    return got
+
+
+def _assert_unwound(sess, timeout=15.0):
+    """The zero-leak unwind contract: no tracked device bytes, no HBM
+    reservation, every semaphore permit back, no shuffle-catalog
+    slots.  Device batches free via GC finalizers, so poll."""
+    dm = sess.device_manager
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        gc.collect()
+        if (dm.allocated_bytes == 0 and dm.reserved_bytes == 0
+                and sess.shuffle_catalog.slot_count() == 0
+                and _available_permits(dm.semaphore)
+                == dm.semaphore.permits):
+            return
+        time.sleep(0.05)
+    gc.collect()
+    assert dm.allocated_bytes == 0, \
+        f"leaked device bytes: {dm.allocated_bytes}"
+    assert dm.reserved_bytes == 0, \
+        f"leaked HBM reservation: {dm.reserved_bytes}"
+    assert sess.shuffle_catalog.slot_count() == 0, \
+        "leaked shuffle-catalog slots"
+    assert _available_permits(dm.semaphore) == dm.semaphore.permits, \
+        "leaked device-semaphore permit"
+
+
+# ==========================================================================
+# CancelToken / check_cancel units (no jax)
+# ==========================================================================
+def test_cancel_token_trips_once_and_checks_raise():
+    tok = CancelToken(7)
+    assert not tok.cancelled()
+    assert tok.cancel("because") is True
+    assert tok.cancel("again") is False  # first reason wins
+    assert tok.cancelled() and tok.reason == "because"
+    with pytest.raises(TpuQueryCancelled) as ei:
+        tok.check("some.site")
+    assert "because" in str(ei.value) and "some.site" in str(ei.value)
+
+
+def test_cancel_token_deadline_expires():
+    tok = CancelToken(8, deadline=time.monotonic() - 0.001)
+    assert tok.expired()
+    with pytest.raises(TpuQueryCancelled):
+        tok.check("deadline.site")
+    assert tok.cancelled()  # the deadline trip cancels the token
+
+
+def test_check_cancel_is_noop_without_binding():
+    check_cancel("anywhere")  # must not raise on an unbound thread
+
+
+# ==========================================================================
+# submit() correctness + per-query attribution
+# ==========================================================================
+def test_submit_matches_collect_with_attribution():
+    sess = srt.Session(
+        {"spark.rapids.tpu.telemetry.enabled": True, **SHUFFLED})
+    serial = _join_agg_df(sess).collect()
+    handles = [sess.submit(_join_agg_df(sess)) for _ in range(3)]
+    for h in handles:
+        got = h.result(timeout=180).to_rows()
+        assert _norm(got) == _norm(serial)
+        assert h.status() == QueryStatus.FINISHED
+        assert h.exec_path == "tpu"
+        # per-query attribution: each handle carries its own metrics,
+        # its own span tree and its own event ring (session.last_* is
+        # last-writer-wins and proves nothing under concurrency)
+        assert any(k.endswith("numOutputRows") for k in h.metrics), \
+            sorted(h.metrics)[:8]
+        assert h.profile is not None
+        evs = {e["event"] for e in h.events()}
+        assert {"query_begin", "query_end"} <= evs, evs
+    qids = {h.profile.query_id for h in handles}
+    assert len(qids) == 3, "span trees not per-query"
+    # a finished handle (result + context) pins device state by
+    # design, and a live DataFrame keeps its planned tree (with cached
+    # uploads) in the session's plan cache — the leak contract applies
+    # once the caller lets go
+    del handles, h
+    _assert_unwound(sess)
+
+
+# ==========================================================================
+# Admission control
+# ==========================================================================
+def test_admission_queue_full_rejects_with_event():
+    from spark_rapids_tpu.telemetry import spans
+
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=250.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.scheduler.maxConcurrent": 1,
+           "spark.rapids.tpu.scheduler.maxQueued": 0}))
+    slow = _join_agg_df(sess)
+    h1 = sess.submit(slow)
+    sched = sess.scheduler
+    _wait_until(lambda: sched.active_count == 1
+                and sched.queued_count == 0,
+                msg="first query dispatched")
+    # bind a telemetry ring on the SUBMITTING thread: the shed must be
+    # observable as an admission_reject event at the point of rejection
+    tele = spans.QueryTelemetry(sess.conf)
+    spans.activate(tele)
+    try:
+        with pytest.raises(QueryRejected):
+            sess.submit(_join_agg_df(sess))
+    finally:
+        spans.deactivate()
+    evs = [e for e in tele.events.snapshot()
+           if e["event"] == "admission_reject"]
+    assert evs and evs[0]["reason"] == "queue_full", evs
+    assert h1.result(timeout=180) is not None
+    del h1, slow
+    _assert_unwound(sess)
+
+
+def test_admission_queue_timeout_sheds_queued_query():
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=300.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.scheduler.maxConcurrent": 1,
+           "spark.rapids.tpu.scheduler.queueTimeoutMs": 120}))
+    h1 = sess.submit(_join_agg_df(sess))
+    h2 = sess.submit(_join_agg_df(sess))
+    with pytest.raises(QueryRejected) as ei:
+        h2.result(timeout=60)
+    assert "queue_timeout" in str(ei.value)
+    assert h2.status() == QueryStatus.REJECTED
+    assert h1.result(timeout=180) is not None  # the runner is unharmed
+    del h1, h2
+    _assert_unwound(sess)
+
+
+def test_priority_dispatches_high_before_low():
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=120.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.scheduler.maxConcurrent": 1}))
+    sched = sess.scheduler
+    head = sess.submit(_join_agg_df(sess))
+    _wait_until(lambda: sched.active_count == 1,
+                msg="head query dispatched")
+    lo = sess.submit(_join_agg_df(sess), priority=0)
+    hi = sess.submit(_join_agg_df(sess), priority=10)
+    hi.result(timeout=180)
+    # maxConcurrent=1: lo can only start after hi finished, and a full
+    # (delayed) run stands between start and finish
+    assert not lo.done(), "low-priority query ran before high-priority"
+    assert lo.result(timeout=180) is not None
+    head.result(timeout=180)
+    del head, lo, hi
+    _assert_unwound(sess)
+
+
+# ==========================================================================
+# Cooperative cancellation — the zero-leak unwind contract (explicit,
+# deadline, injected)
+# ==========================================================================
+def test_explicit_cancel_unwinds_with_zero_leaks():
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=400.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.telemetry.enabled": True}))
+    h = sess.submit(_join_agg_df(sess))
+    _wait_until(lambda: h.status() == QueryStatus.RUNNING,
+                msg="query running")
+    assert h.cancel("user hit ctrl-c") is True
+    with pytest.raises(TpuQueryCancelled) as ei:
+        h.result(timeout=120)
+    assert "user hit ctrl-c" in str(ei.value)
+    assert h.status() == QueryStatus.CANCELLED
+    evs = [e for e in h.events() if e["event"] == "query_cancelled"]
+    assert evs, "terminal query_cancelled event missing"
+    del h
+    _assert_unwound(sess)
+
+
+def test_cancel_queued_query_is_immediate():
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=300.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.scheduler.maxConcurrent": 1}))
+    h1 = sess.submit(_join_agg_df(sess))
+    h2 = sess.submit(_join_agg_df(sess))
+    assert h2.cancel("changed my mind") is True
+    with pytest.raises(TpuQueryCancelled):
+        h2.result(timeout=30)
+    assert h2.status() == QueryStatus.CANCELLED
+    assert h1.result(timeout=180) is not None
+    del h1, h2
+    _assert_unwound(sess)
+
+
+def test_query_deadline_cancels_with_zero_leaks():
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=500.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.scheduler.queryTimeoutMs": 150}))
+    h = sess.submit(_join_agg_df(sess))
+    with pytest.raises(TpuQueryCancelled) as ei:
+        h.result(timeout=120)
+    assert "deadline" in str(ei.value).lower(), ei.value
+    assert h.status() == QueryStatus.CANCELLED
+    del h
+    _assert_unwound(sess)
+
+
+@pytest.mark.fault_injection
+@pytest.mark.parametrize("skip", [2, 9])
+def test_injected_cancel_unwinds_with_zero_leaks(skip):
+    """``fault.injection.type=cancel`` fires at a deterministic
+    checkpoint (any site — the OOM-funnel checkpoints included, so even
+    exchange-free plans are coverable) and must unwind like any other
+    cancellation: zero leaked bytes/permits/slots, terminal event."""
+    sess = srt.Session(_inject(
+        "nth", "cancel", skip=skip, **SHUFFLED,
+        **{"spark.rapids.tpu.telemetry.enabled": True}))
+    h = sess.submit(_join_agg_df(sess))
+    with pytest.raises(TpuQueryCancelled) as ei:
+        h.result(timeout=120)
+    assert "injected cancel" in str(ei.value)
+    assert h.status() == QueryStatus.CANCELLED
+    evs = [e for e in h.events() if e["event"] == "query_cancelled"]
+    assert evs, "terminal query_cancelled event missing"
+    del h, ei
+    _assert_unwound(sess)
+    # the next query on the SAME session must run clean: the scoped
+    # injector died with its query (nth is one-shot per query, so a
+    # fresh scoped injector would fire again — prove it does, and
+    # recovers the session state either way)
+    h2 = sess.submit(_join_agg_df(sess))
+    with pytest.raises(TpuQueryCancelled):
+        h2.result(timeout=120)
+    del h2
+    _assert_unwound(sess)
+
+
+@pytest.mark.fault_injection
+def test_injected_cancel_reaches_exchange_free_plans():
+    """A plan with no exchange/spill never passes a maybe_inject_fault
+    site — the cancel fault must still be reachable through the
+    allocation checkpoints (the ISSUE contract: cancellation is
+    testable everywhere the OOM injector reaches)."""
+    sess = srt.Session(_inject("always", "cancel"))
+    h = sess.submit(_select_df(sess))
+    with pytest.raises(TpuQueryCancelled):
+        h.result(timeout=120)
+    assert h.status() == QueryStatus.CANCELLED
+    del h
+    _assert_unwound(sess)
+
+
+# ==========================================================================
+# Per-query failure isolation (the circuit breaker)
+# ==========================================================================
+@pytest.mark.fault_injection
+def test_circuit_breaker_degrades_one_query_not_its_neighbor():
+    """A query exhausting its retry budget trips ITS circuit breaker
+    onto the CPU-exec plan; a concurrent query with no faulting sites
+    finishes on the TPU path, and the process-global fault counters
+    stay untouched (no cross-query poisoning)."""
+    from spark_rapids_tpu.fault.stats import DEGRADE_CPU
+    from spark_rapids_tpu.fault.stats import GLOBAL as _fault_stats
+
+    base = dict(_fault_stats.snapshot())
+    sess = srt.Session(_inject(
+        "always", "stage_crash", site="exchange.write", **{
+            "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+            "spark.rapids.tpu.sql.taskRetries": 0,
+            "spark.rapids.tpu.scheduler.maxConcurrent": 2,
+        }))
+    oracle_bad = _join_agg_df(
+        srt.Session(tpu_enabled=False)).collect()
+    serial_good = _select_df(
+        srt.Session(tpu_enabled=True)).collect()
+    h_bad = sess.submit(_join_agg_df(sess))   # hits exchange.write
+    h_good = sess.submit(_select_df(sess))    # no exchange: never fires
+    good = h_good.result(timeout=180).to_rows()
+    bad = h_bad.result(timeout=180).to_rows()
+    assert h_good.exec_path == "tpu"
+    assert h_bad.exec_path == "cpu"
+    assert _norm(bad) == _norm(oracle_bad)
+    assert _norm(good) == _norm(serial_good)
+    assert h_bad.metrics.get("fault.degradeLevel") == DEGRADE_CPU
+    assert h_good.metrics.get("fault.degradeLevel", 0) == 0
+    # isolation proof: the breaker never wrote the process-global
+    # fault counters (a direct-execute neighbor would observe them)
+    assert dict(_fault_stats.snapshot()) == base
+    del h_bad, h_good
+    _assert_unwound(sess)
+
+
+def test_dead_worker_never_strands_a_device_permit():
+    """Regression for a permit leak only the scheduler could expose:
+    ``collect_batches``'s inline (``threads <= 1``) path runs the task
+    ON the calling thread, and used to exit without dropping that
+    thread's device hold.  Serially that is invisible — the main
+    thread idempotently re-acquires its own stale hold — but a
+    scheduler worker dies with its query, and a dead thread's permit
+    can never be released, so every finished single-partition query
+    permanently shrank the pool until the whole process stalled.
+    Run more sequential single-partition queries than there are
+    permits: with the leak, the pool is empty partway through and the
+    later queries stall into the watchdog/CPU fallback."""
+    sess = srt.Session({**FAST, "spark.rapids.tpu.sql.taskThreads": 1})
+    sem = sess.device_manager.semaphore
+    for i in range(sem.permits + 2):
+        h = sess.submit(_select_df(sess))
+        assert h.result(timeout=120) is not None, f"query {i} stalled"
+        assert h.exec_path == "tpu", f"query {i} degraded off the TPU"
+        del h
+    _assert_unwound(sess)
