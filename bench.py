@@ -248,15 +248,24 @@ def _table_bytes(raw):
 
 
 def _shuffle_microbench():
-    """Device shuffle-write path: partition ids + tile prep for the
-    collective exchange (the map-side contiguousSplit analogue)."""
+    """Shuffle-write path, one entry per ``shuffle.mode``:
+
+    * ``device`` — partition ids + the packed partition-build kernel;
+      the block stays in HBM (zero host copies by construction, the
+      property tests/test_lint_shuffle.py pins at the AST level).
+    * ``host``   — the staged path the device mode replaced: d2h of
+      the whole batch, CRC32C stamp of every column frame, h2d
+      promote.  The device/host GB/s ratio is the headline win.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from spark_rapids_tpu import types as T
-    from spark_rapids_tpu.data.column import HostBatch, host_to_device
+    from spark_rapids_tpu.data.column import (HostBatch, device_to_host,
+                                              host_to_device)
+    from spark_rapids_tpu.fault.integrity import checksum_frame
     from spark_rapids_tpu.parallel import exchange as X
+    from spark_rapids_tpu.shuffle import device_shuffle as DS
 
     n = 1 << 20
     rng = np.random.RandomState(0)
@@ -269,23 +278,138 @@ def _shuffle_microbench():
     db = host_to_device(hb)
     nbytes = db.device_bytes()
     P = 8
-    cap = db.padded_rows  # worst-case capacity, no row loss
 
-    def write_path(batch):
+    def device_write(batch):
         pids = X.device_partition_ids(batch, [0], P)
-        rows, valid = X.bucket_rows(pids, P, cap)
-        return X._gather_tiles(batch, rows, valid)
+        return DS.packed_build(batch, pids, P)
 
-    jfn = jax.jit(write_path)
-    out = jfn(db)
-    jax.block_until_ready(out)
+    jfn = jax.jit(device_write)
+    jax.block_until_ready(jfn(db))
 
-    def run():
+    def run_device():
         jax.block_until_ready(jfn(db))
 
-    best, noise = _best(run, iters=ITERS)
-    return {"gb_per_s": round(nbytes / best / 1e9, 3),
-            "rows": n, "bytes": nbytes, "noise_pct": round(noise, 1)}
+    dev_best, dev_noise = _best(run_device, iters=ITERS)
+
+    pid_fn = jax.jit(
+        lambda batch: X.device_partition_ids(batch, [0], P))
+    jax.block_until_ready(pid_fn(db))
+
+    def run_host():
+        jax.block_until_ready(pid_fn(db))
+        staged = device_to_host(db, trim=False)
+        for col in staged.columns:
+            checksum_frame(np.ascontiguousarray(col.data).view(np.uint8)
+                           if col.data.dtype != np.uint8 else col.data)
+        jax.block_until_ready(host_to_device(staged).columns[0].data)
+
+    host_best, host_noise = _best(run_host, iters=ITERS)
+    return {
+        "rows": n, "bytes": nbytes,
+        "device": {"gb_per_s": round(nbytes / dev_best / 1e9, 3),
+                   "noise_pct": round(dev_noise, 1),
+                   "host_copy_bytes": 0},
+        "host": {"gb_per_s": round(nbytes / host_best / 1e9, 3),
+                 "noise_pct": round(host_noise, 1)},
+        "device_vs_host": round(host_best / dev_best, 2),
+    }
+
+
+def _q3_exchange_breakdown():
+    """Wall decomposition of one q3-shaped exchange round at 128K rows
+    (sized so the emulated-mesh collective fits the bench budget):
+    the packed partition-build kernel (map side), the mesh collective
+    dispatch (`exchange_step` over every local device), and the
+    reduce-side concat of the received slices.  On a 1-device mesh the
+    collective degenerates to a copy — the number is still emitted so
+    device runs and CPU-fallback runs produce the same JSON shape."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_tpu.data.column import HostBatch, host_to_device
+    from spark_rapids_tpu.exec.coalesce import concat_device_batches
+    from spark_rapids_tpu.parallel import exchange as X
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.shuffle import device_shuffle as DS
+
+    n = 1 << 17
+    rng = np.random.RandomState(3)
+    # q3's exchange ships (custkey, orderkey, revenue terms)
+    hb = HostBatch.from_pydict({
+        "o_custkey": rng.randint(0, 150_000, n).astype(np.int64),
+        "l_orderkey": rng.randint(0, n, n).astype(np.int64),
+        "l_extendedprice": rng.rand(n) * 1e5,
+        "l_discount": rng.rand(n) * 0.1,
+    })
+    db = host_to_device(hb)
+    P = 8
+
+    build = jax.jit(lambda b: DS.packed_build(
+        b, X.device_partition_ids(b, [0], P), P))
+    block, counts, starts = build(db)
+    jax.block_until_ready(block.columns[0].data)
+    def run_build():
+        blk, _c, _s = build(db)
+        jax.block_until_ready(blk.columns[0].data)
+
+    build_s, _ = _best(run_build, iters=ITERS)
+
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    per = db.padded_rows // n_dev
+
+    def local(b):
+        pids = X.device_partition_ids(b, [0], n_dev)
+        return X.collective_exchange(b, pids, n_dev,
+                                     mesh.axis_names[0], capacity=per)
+
+    stacked = X.stack_to_mesh(
+        mesh, X.stack_partitions(_even_split(db, n_dev)))
+    step = X.exchange_step(mesh, local)
+    jax.block_until_ready(step(stacked).columns[0].data)
+    # the emulated-mesh collective carries a large fixed dispatch cost
+    # on CPU fallback: bound it to 2 timed iters under a hard deadline
+    coll_s, _ = _best(
+        lambda: jax.block_until_ready(step(stacked).columns[0].data),
+        iters=2, warmup=0,
+        deadline=time.perf_counter() + 30)
+
+    got = DS.fetch_counts([(counts, starts)])
+    c_np, s_np = got[0]
+    slices = [DS.packed_slice(block, jnp.int32(int(s_np[p])),
+                              jnp.int32(int(c_np[p])))
+              for p in range(P) if int(c_np[p])]
+    jax.block_until_ready(slices[0].columns[0].data)
+    concat_s, _ = _best(
+        lambda: jax.block_until_ready(
+            concat_device_batches(slices, 128).columns[0].data),
+        iters=ITERS)
+
+    return {"rows": n, "n_devices": int(n_dev),
+            "partition_build_s": round(build_s, 5),
+            "collective_s": round(coll_s, 5),
+            "concat_s": round(concat_s, 5)}
+
+
+def _even_split(db, k):
+    """Split a DeviceBatch into k equal-padded shards (bench-local
+    helper for mesh placement)."""
+    from spark_rapids_tpu.data.column import DeviceBatch, DeviceColumn
+    import jax.numpy as jnp
+
+    per = db.padded_rows // k
+    out = []
+    for i in range(k):
+        lo, hi = i * per, (i + 1) * per
+        cols = [DeviceColumn(c.dtype, c.data[lo:hi], c.validity[lo:hi],
+                             c.lengths[lo:hi]
+                             if c.lengths is not None else None)
+                for c in db.columns]
+        nr = jnp.clip(jnp.asarray(db.num_rows, dtype=jnp.int32) - lo,
+                      0, per)
+        out.append(DeviceBatch(db.schema, cols, nr))
+    return out
 
 
 def _q6_scan_breakdown(raw, iters=3):
@@ -680,6 +804,16 @@ def child_main(platform):
 
     remaining = _deadline() - time.perf_counter()
     shuffle = _shuffle_microbench() if remaining > 20 else None
+    if shuffle is not None:
+        _emit({"progress": "shuffle_write", **shuffle})
+    remaining = _deadline() - time.perf_counter()
+    q3_exchange = None
+    if remaining > 60:
+        try:
+            q3_exchange = _q3_exchange_breakdown()
+        except Exception as e:  # noqa: BLE001 - never lose the summary
+            q3_exchange = {"error": f"{type(e).__name__}: {e}"[:200]}
+        _emit({"progress": "q3_exchange", **q3_exchange})
     remaining = _deadline() - time.perf_counter()
     q6_scan = _q6_scan_breakdown(raw) if remaining > 25 else None
     if q6_scan is not None:
@@ -734,6 +868,7 @@ def child_main(platform):
         "elapsed_s": round(time.perf_counter() - _T0, 1),
         "per_query": per_query,
         "shuffle_write": shuffle,
+        "q3_exchange": q3_exchange,
         "q6_scan": q6_scan,
         "ooc": ooc,
         "tpcxbb_mini": tpcxbb_mini,

@@ -134,8 +134,39 @@ def dump_markdown() -> str:
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
     lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC,
               "", _SCHEDULING_DOC, "", _OBSERVABILITY_DOC, "",
-              _PERF_TUNING_DOC]
+              _PERF_TUNING_DOC, "", _SHUFFLE_DOC]
     return "\n".join(lines)
+
+
+_SHUFFLE_DOC = """\
+## Device-resident shuffle
+
+The `shuffle.*` confs (table above) configure the exchange data path
+(`exec/exchange.py`, `shuffle/device_shuffle.py`, docs/shuffle.md):
+
+* **Device path** (`shuffle.mode=device`, or `auto` with HBM headroom)
+  — hash/round-robin/single-partitioned shuffle blocks stay resident in
+  HBM: one jitted partition-build kernel (shared through the kernel
+  cache) sorts each input batch by destination partition and records
+  per-partition start/count vectors, and readers slice their partition
+  out with one gather kernel.  No per-partition d2h -> CRC -> h2d round
+  trip; CRC32C stamping happens only if a block crosses the spill/host
+  boundary.  Mesh-distributed plans move the same packed form between
+  participants via one fused `lax.all_to_all` collective
+  (`parallel/exchange.py`).
+* **Host path** (`shuffle.mode=host`) — every block is staged to host
+  memory immediately and CRC32C-stamped, the fully-verified pre-device
+  behavior; `auto` degrades to it under HBM pressure, and blocks the
+  spill framework demotes off-device are verified on re-read either
+  way.
+* **Fallback ladder** — a device-shuffle query that exhausts fault
+  recovery re-executes on the host shuffle path (a `shuffle_fallback` +
+  `degrade` event, counted in `fault.numShuffleFallbacks`) before the
+  CPU rung.
+* **Observability** — `shuffle.deviceBytes` / `shuffle.hostBytes` /
+  `shuffle.collectiveTime` land in `Session.last_metrics`; bench.py
+  reports device vs host `shuffle_write` GB/s and a `q3_exchange`
+  wall breakdown."""
 
 
 _SCHEDULING_DOC = """\
@@ -374,9 +405,10 @@ FAULT_INJECTION_SEED = conf("spark.rapids.tpu.fault.injection.seed").doc(
     "Seed for mode=random's injection decisions").int_conf(0)
 FAULT_INJECTION_SITE = conf("spark.rapids.tpu.fault.injection.site").doc(
     "Substring filter on checkpoint sites (spill.write, spill.read, "
-    "exchange.write, exchange.read, stage.run, leaf.drain, host.stack); "
-    "empty matches every site.  Only matching checkpoints advance the "
-    "skipCount counter").string_conf("")
+    "exchange.write, exchange.write.device, exchange.read, stage.run, "
+    "leaf.drain, host.stack, shuffle.collective); empty matches every "
+    "site.  Only matching checkpoints advance the skipCount counter"
+).string_conf("")
 FAULT_INJECTION_DELAY_MS = conf(
     "spark.rapids.tpu.fault.injection.delayMs").doc(
     "type=delay: milliseconds the injected straggler sleeps at the "
@@ -601,6 +633,21 @@ BROADCAST_THRESHOLD = conf(
     "Max estimated build-side bytes for a broadcast hash join (reference: "
     "spark.sql.autoBroadcastJoinThreshold feeding GpuBroadcastMeta); "
     "set to 0 to force shuffled joins").long_conf(10 * 1024 * 1024)
+SHUFFLE_MODE = conf("spark.rapids.tpu.shuffle.mode").doc(
+    "Exchange data path: device (shuffle blocks stay resident in HBM as "
+    "packed blocks built by one jitted partition-build kernel — no "
+    "d2h/h2d round-trip per partition), host (every block is staged to "
+    "host memory and CRC32C-stamped immediately, the pre-device "
+    "behavior), or auto (device while the HBM arena has headroom, host "
+    "under memory pressure).  Range partitioning always uses the host "
+    "path (bounds need a full host-side drain); the degradation ladder "
+    "re-executes a failed device-shuffle query on the host path before "
+    "falling to the CPU rung").string_conf("auto")
+SHUFFLE_TARGET_BATCH_ROWS = conf(
+    "spark.rapids.tpu.shuffle.targetBatchRows").doc(
+    "Exchange writes coalesce sub-target input batches up to this many "
+    "rows before the partition-build kernel runs, so a stream of tiny "
+    "batches costs one build dispatch instead of N").int_conf(32768)
 
 # --- ML interop -----------------------------------------------------------
 EXPORT_COLUMNAR_RDD = conf("spark.rapids.tpu.sql.exportColumnarRdd").doc(

@@ -601,40 +601,59 @@ def device_to_host(batch: DeviceBatch, trim: bool = True) -> HostBatch:
     ``trim=False`` skips the device-side trim: the trim ALLOCATES new
     device buffers, which the spill path (called exactly when HBM is
     exhausted) must not do."""
+    return device_to_host_many([batch], trim=trim)[0]
+
+
+def device_to_host_many(batches: List[DeviceBatch],
+                        trim: bool = True) -> List[HostBatch]:
+    """Download SEVERAL device batches in two batched transfers: one
+    sync for every row count, one ``jax.device_get`` of every array of
+    every batch.  The cross-batch form of :func:`device_to_host` — a
+    result drain of B small batches pays 2 round trips instead of 2B
+    (the host boundary below a limit/collect is exactly such a
+    stream)."""
     import jax
 
-    n = int(batch.num_rows)
-    k = bucket_rows(max(n, 1)) if trim else batch.padded_rows
+    if not batches:
+        return []
+    ns = [int(n) for n in jax.device_get([b.num_rows for b in batches])]
     arrs = []
-    spec = []  # per column: has_lengths
-    for c in batch.columns:
-        data, validity, lengths = c.data, c.validity, c.lengths
-        if k < batch.padded_rows:
-            data, validity = data[:k], validity[:k]
-            lengths = lengths[:k] if lengths is not None else None
-        arrs.extend([data, validity] if lengths is None
-                    else [data, validity, lengths])
-        spec.append(lengths is not None)
+    specs = []  # per batch, per column: has_lengths
+    for batch, n in zip(batches, ns):
+        k = bucket_rows(max(n, 1)) if trim else batch.padded_rows
+        spec = []
+        for c in batch.columns:
+            data, validity, lengths = c.data, c.validity, c.lengths
+            if k < batch.padded_rows:
+                data, validity = data[:k], validity[:k]
+                lengths = lengths[:k] if lengths is not None else None
+            arrs.extend([data, validity] if lengths is None
+                        else [data, validity, lengths])
+            spec.append(lengths is not None)
+        specs.append(spec)
     host = jax.device_get(arrs)
-    cols: List[HostColumn] = []
+    out: List[HostBatch] = []
     i = 0
-    for c, has_len in zip(batch.columns, spec):
-        if has_len:
-            bm, validity, ln = host[i:i + 3]
-            i += 3
-        else:
-            bm, validity = host[i:i + 2]
-            i += 2
-        validity = np.asarray(validity)[:n]
-        if c.dtype.id is TypeId.STRING:
-            data = dstrings.decode(np.asarray(bm)[:n],
-                                   np.asarray(ln)[:n], validity)
-        else:
-            data = np.asarray(bm)[:n].astype(c.dtype.np_dtype,
-                                             copy=False)
-        cols.append(HostColumn(c.dtype, data,
-                               None if validity.all() else validity))
-    return HostBatch(batch.schema, cols)
+    for batch, n, spec in zip(batches, ns, specs):
+        cols: List[HostColumn] = []
+        for c, has_len in zip(batch.columns, spec):
+            if has_len:
+                bm, validity, ln = host[i:i + 3]
+                i += 3
+            else:
+                bm, validity = host[i:i + 2]
+                i += 2
+            validity = np.asarray(validity)[:n]
+            if c.dtype.id is TypeId.STRING:
+                data = dstrings.decode(np.asarray(bm)[:n],
+                                       np.asarray(ln)[:n], validity)
+            else:
+                data = np.asarray(bm)[:n].astype(c.dtype.np_dtype,
+                                                 copy=False)
+            cols.append(HostColumn(c.dtype, data,
+                                   None if validity.all() else validity))
+        out.append(HostBatch(batch.schema, cols))
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -34,6 +34,12 @@ class CoalesceGoal:
             if other.target is None:
                 return other
             return self if self.target >= other.target else other
+        if isinstance(self, TargetRows) and isinstance(other, TargetRows):
+            if self.rows is None:
+                return self
+            if other.rows is None:
+                return other
+            return self if self.rows >= other.rows else other
         return self
 
 
@@ -47,6 +53,19 @@ class TargetSize(CoalesceGoal):
 
     def __repr__(self):  # pragma: no cover
         return f"TargetSize({self.target})"
+
+
+class TargetRows(CoalesceGoal):
+    """Row-count coalesce goal (``rows=None`` resolves the session's
+    ``shuffle.targetBatchRows`` at execute time) — declared by the
+    shuffle exchange so a stream of tiny scan batches is merged before
+    the per-batch partition-build kernel dispatches; zero disables."""
+
+    def __init__(self, rows: Optional[int] = None):
+        self.rows = rows
+
+    def __repr__(self):  # pragma: no cover
+        return f"TargetRows({self.rows})"
 
 
 class RequireSingleBatch(CoalesceGoal):

@@ -11,7 +11,8 @@ from typing import List
 
 import numpy as np
 
-from ..config import BATCH_SIZE_BYTES, BUCKET_MIN_ROWS
+from ..config import (BATCH_SIZE_BYTES, BUCKET_MIN_ROWS,
+                      SHUFFLE_TARGET_BATCH_ROWS)
 from ..data.column import DeviceBatch, DeviceColumn, bucket_rows
 from ..utils import metrics as M
 from ..utils.tracing import trace_range
@@ -19,6 +20,7 @@ from .base import (
     CoalesceGoal,
     DevicePartitionedData,
     RequireSingleBatch,
+    TargetRows,
     TargetSize,
     TpuExec,
 )
@@ -88,6 +90,10 @@ class TpuCoalesceBatchesExec(TpuExec):
             if isinstance(self.goal, TargetSize) \
             and self.goal.target is not None \
             else ctx.conf.get(BATCH_SIZE_BYTES)
+        rows_target = None
+        if isinstance(self.goal, TargetRows):
+            rows_target = self.goal.rows if self.goal.rows is not None \
+                else ctx.conf.get(SHUFFLE_TARGET_BATCH_ROWS)
 
         def make(pid):
             def it():
@@ -98,6 +104,26 @@ class TpuCoalesceBatchesExec(TpuExec):
                     with trace_range("TpuCoalesce.concat",
                                      self.metrics[M.TOTAL_TIME]):
                         yield concat_device_batches(batches, min_bucket)
+                    return
+                if rows_target is not None:
+                    if rows_target <= 0:  # disabled: passthrough
+                        yield from child.iterator(pid)
+                        return
+                    # accumulate by PADDED rows — a host num_rows sync
+                    # per input batch would cost the RTTs the coalesce
+                    # exists to amortize (padding only over-fills)
+                    pending: List[DeviceBatch] = []
+                    pending_rows = 0
+                    for db in child.iterator(pid):
+                        r = db.padded_rows
+                        if pending and pending_rows + r > rows_target:
+                            yield concat_device_batches(pending,
+                                                        min_bucket)
+                            pending, pending_rows = [], 0
+                        pending.append(db)
+                        pending_rows += r
+                    if pending:
+                        yield concat_device_batches(pending, min_bucket)
                     return
                 pending: List[DeviceBatch] = []
                 pending_bytes = 0

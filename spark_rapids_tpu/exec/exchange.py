@@ -129,8 +129,10 @@ def _free_shuffle_buffers(fw, store, spill_listener=None,
     if catalog is not None and shuffle_id is not None:
         catalog.unregister_shuffle(shuffle_id)  # idempotent
     else:
-        for buf_id, _rr in (store[0] if store else ()):
-            fw.remove_batch(buf_id)
+        # entries are (buf_id, rr) on the host path and
+        # (buf_id, counts, starts) on the device path
+        for entry in (store[0] if store else ()):
+            fw.remove_batch(entry[0])
     if spill_listener is not None:
         try:
             fw.spill_listeners.remove(spill_listener)
@@ -150,6 +152,18 @@ class TpuShuffleExchangeExec(TpuExec):
         # fingerprint — compile privately (key=None); counters still apply
         self._hash_kernel = jit_kernel(self._hash_pids)
         self._slice_kernel = jit_kernel(self._slice)
+        # device-resident path: packed partition-build + slice kernels,
+        # shared across execs through the kernel cache (module-level
+        # bodies keyed by schema layout + fan-out).  Range partitioning
+        # never takes the packed path (its placement needs sampled
+        # bounds that only exist after the full write drain).
+        if not isinstance(self.partitioning, RangePartitioning):
+            from ..shuffle import device_shuffle as DS
+
+            self._build_kernel = DS.packed_build_kernel(
+                self.schema, self.n_out)
+            self._packed_slice_kernel = DS.packed_slice_kernel(
+                self.schema)
         if isinstance(self.partitioning, RangePartitioning):
             self._passes_kernel = jit_kernel(
                 lambda b: range_key_passes(
@@ -173,6 +187,16 @@ class TpuShuffleExchangeExec(TpuExec):
     @property
     def schema(self):
         return self.children[0].schema
+
+    @property
+    def children_coalesce_goal(self):
+        # coalesce sub-target input batches to shuffle.targetBatchRows
+        # before the partition-build kernel runs: a stream of tiny scan
+        # batches costs ONE build dispatch instead of N (rows=None
+        # resolves the conf at execute time)
+        from .base import TargetRows
+
+        return [TargetRows(None)]
 
     # ------------------------------------------------------------------
     def _hash_pids(self, batch: DeviceBatch):
@@ -209,8 +233,26 @@ class TpuShuffleExchangeExec(TpuExec):
 
         import threading
 
+        from ..config import SHUFFLE_MODE
+        from ..shuffle import device_shuffle as DS
+        from ..telemetry.events import emit_event
+
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
+        is_range = isinstance(self.partitioning, RangePartitioning)
+        # exchange data path: device (packed blocks stay in HBM), host
+        # (every block staged + CRC-stamped immediately — the
+        # pre-device behavior and the ladder's host-shuffle rung), auto
+        # (device while the arena has headroom)
+        dm = ctx.session.device_manager \
+            if getattr(ctx, "session", None) is not None else None
+        mode = DS.resolve_mode(
+            ctx.conf.get(SHUFFLE_MODE),
+            force_host=getattr(ctx, "force_host_shuffle", False),
+            headroom=dm.headroom() if dm is not None else 1)
+        # range never packs (bounds exist only after the full drain) —
+        # it runs the legacy device-resident write, staging under host
+        device_path = mode == "device" and not is_range
         store: List[list] = []
         # shuffle-scoped buffer group (reference: ShuffleBufferCatalog
         # shuffleId->mapId->buffers index + per-shuffle cleanup)
@@ -231,31 +273,49 @@ class TpuShuffleExchangeExec(TpuExec):
         elect_lock = threading.Lock()
         done = threading.Event()
         state = {"writer": False, "error": None, "bounds": None}
-        is_range = isinstance(self.partitioning, RangePartitioning)
         sem = self._sem(ctx)
         # buf_id -> (id(device_batch), pids): partition ids are computed
         # once per resident batch and reused by all n_out readers; a
         # spill+promote cycle yields a new batch object and recomputes
         pid_cache: dict = {}
+        # buf_id -> block bytes for DEVICE-path blocks still resident:
+        # a spill of one of these is the device-shuffle → host-staging
+        # degradation, surfaced as hostBytes + a shuffle_fallback event
+        device_sizes: dict = {}
         fw = SpillFramework.get()
         rctx = R.RetryContext.for_exec(ctx, "TpuShuffleExchangeExec")
+        rr_state = {"rr": None}  # device round-robin offset (no sync)
 
         def write_one(b):
             # registering a map-output batch is the write-side
             # allocation checkpoint; an OOM retries after spill+backoff
             # (the batch itself is the checkpointed input).  The fault
             # checkpoint covers delay/crash injection; corruption is
-            # injected inside add_batch at the "exchange.write" site.
+            # injected inside add_batch at the write site — the device
+            # path's ".device" suffix lets a sweep target one data path
+            # while a plain "exchange.write" filter matches both.
             R.maybe_inject_oom("TpuShuffleExchange.write")
-            F.maybe_inject_fault("exchange.write")
-            return fw.add_batch(b, site="exchange.write")
+            if not device_path:
+                F.maybe_inject_fault("exchange.write")
+                return fw.add_batch(b, site="exchange.write")
+            F.maybe_inject_fault("exchange.write.device")
+            pids = self._pids(b, rr_state["rr"], None)
+            block, counts, starts = self._build_kernel(
+                b, pids, self.n_out, metrics=self.metrics)
+            buf_id = fw.add_batch(block, site="exchange.write.device")
+            size = block.device_bytes()
+            device_sizes[buf_id] = size
+            DS.GLOBAL.add("deviceBytes", size)
+            return buf_id, counts, starts
 
         def _drain_child():
             import jax
 
             import jax.numpy as jnp
 
-            items = []  # (buffer id, round-robin start offset)
+            # device path: (buf_id, counts np, starts np)
+            # host path:   (buffer id, round-robin start offset)
+            items = []
             rr = 0
             samples = []   # host key samples for the range bounds
             pending = []   # (buf_id, id(batch), passes) for pid prefill
@@ -264,17 +324,31 @@ class TpuShuffleExchangeExec(TpuExec):
             # (batches past the cap recompute pids at first read)
             pend_budget = 64 * 1024 * 1024
             # chunk entries hold NO batch reference — only the buffer
-            # id plus tiny device handles (count scalar, sample tile) —
-            # so a spill of a chunk member actually frees its HBM
-            chunk = []  # (buf_id, num_rows handle, sample handle|None)
+            # id plus tiny device handles (count/starts vectors, sample
+            # tile) — so a spill of a chunk member actually frees its HBM
+            chunk = []
+            rr_state["rr"] = jnp.int32(0)
 
             def flush():
-                # ONE batched readback of the chunk's row counts and
-                # range samples — a per-batch int(num_rows) is a full
-                # device RTT each, which dominates shuffle writes on a
+                # ONE batched readback of the chunk's tiny per-block
+                # vectors — a per-batch int(num_rows) is a full device
+                # RTT each, which dominates shuffle writes on a
                 # remote-TPU link
                 nonlocal rr
                 if not chunk:
+                    return
+                if device_path:
+                    got = DS.fetch_counts([(c, s) for _b, c, s in chunk])
+                    for (buf_id, _c, _s), (counts, starts) in zip(
+                            chunk, got):
+                        counts = np.asarray(counts)
+                        if not counts.sum():
+                            device_sizes.pop(buf_id, None)
+                            fw.remove_batch(buf_id)
+                            continue
+                        items.append((buf_id, counts,
+                                      np.asarray(starts)))
+                    chunk.clear()
                     return
                 got = jax.device_get([(nr, samp)
                                       for _b, nr, samp in chunk])
@@ -295,30 +369,54 @@ class TpuShuffleExchangeExec(TpuExec):
                                  self.metrics[M.TOTAL_TIME]):
                     for pid in range(child.n_partitions):
                         for b in child.iterator(pid):
-                            buf_id = R.retry_call(
+                            out = R.retry_call(
                                 lambda b=b: write_one(b), rctx)
+                            if device_path:
+                                buf_id, counts, starts = out
+                                chunk.append((buf_id, counts, starts))
+                                # round-robin offset advances on device
+                                # (same write order as the host path →
+                                # bit-identical placement, no sync)
+                                rr_state["rr"] = (
+                                    rr_state["rr"] + jnp.asarray(
+                                        b.num_rows, dtype=jnp.int32)
+                                ) % self.n_out
+                            else:
+                                buf_id = out
                             added.append(buf_id)
                             if catalog is not None:
                                 catalog.add_buffer(shuffle_id, pid,
                                                    buf_id)
-                            samp = None
-                            if is_range:
-                                passes = self._passes_kernel(b)
-                                nr = jnp.asarray(b.num_rows,
-                                                 dtype=jnp.int32)
-                                samp = self._sample_kernel(passes, nr)
-                                if pend_budget > 0:
-                                    pending.append((buf_id, id(b),
-                                                    passes))
-                                    pend_budget -= passes.size * 8
-                            chunk.append((buf_id,
-                                          jnp.asarray(b.num_rows,
-                                                      dtype=jnp.int32),
-                                          samp))
+                            if not device_path:
+                                if mode == "host":
+                                    # the host-staged path: serialize +
+                                    # CRC-stamp NOW, not at spill time
+                                    staged = fw.stage_to_host(buf_id)
+                                    if staged:
+                                        DS.GLOBAL.add("hostBytes",
+                                                      staged)
+                                samp = None
+                                if is_range:
+                                    passes = self._passes_kernel(b)
+                                    nr = jnp.asarray(b.num_rows,
+                                                     dtype=jnp.int32)
+                                    samp = self._sample_kernel(passes,
+                                                               nr)
+                                    if pend_budget > 0:
+                                        pending.append((buf_id, id(b),
+                                                        passes))
+                                        pend_budget -= passes.size * 8
+                                chunk.append((buf_id,
+                                              jnp.asarray(
+                                                  b.num_rows,
+                                                  dtype=jnp.int32),
+                                              samp))
                             if len(chunk) >= 32:
                                 flush()
                     flush()
             except BaseException:
+                for bid in added:
+                    device_sizes.pop(bid, None)
                 # a failed attempt must not leave its partial map
                 # output resident until query end — the re-armed retry
                 # registers a full fresh set.  The catalog slots go
@@ -343,7 +441,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 # were removed there, and a pid entry for a dead buf_id
                 # would pin unspillable HBM forever (no spill listener
                 # ever fires for it).
-                live = {buf_id for buf_id, _rr in items}
+                live = {it[0] for it in items}
                 for buf_id, bid, passes in pending:
                     if buf_id in live:
                         pid_cache[buf_id] = (
@@ -404,9 +502,18 @@ class TpuShuffleExchangeExec(TpuExec):
             return store[0]
 
         # drop cached pids the moment their batch is spilled off the
-        # device — they are unspillable HBM and would defeat the spill
+        # device — they are unspillable HBM and would defeat the spill.
+        # A spilled DEVICE-path block is the per-buffer degradation
+        # rung: the block serializes + CRC-stamps on the way down, so
+        # account its bytes to the host side and surface the fallback.
         def on_spill(bid):
             pid_cache.pop(bid, None)
+            size = device_sizes.pop(bid, None)
+            if size:
+                DS.GLOBAL.add("hostBytes", size)
+                DS.GLOBAL.add("numFallbacks")
+                emit_event("shuffle_fallback", reason="spill",
+                           buf_id=bid, bytes=size)
 
         fw.spill_listeners.append(on_spill)
 
@@ -430,9 +537,10 @@ class TpuShuffleExchangeExec(TpuExec):
                 state["writer"] = False
                 state["error"] = cause
                 done.clear()
-            ids = [bid for bid, _rr in old]
+            ids = [it[0] for it in old]
             for bid in ids:
                 pid_cache.pop(bid, None)
+                device_sizes.pop(bid, None)
             if catalog is not None:
                 catalog.drop_buffers(shuffle_id, ids)
             else:
@@ -458,8 +566,17 @@ class TpuShuffleExchangeExec(TpuExec):
                             yield out
                     outs.clear()
 
-                for buf_id, rr_start in materialized():
+                for item in materialized():
                     F.maybe_inject_fault("exchange.read")
+                    buf_id = item[0]
+                    if device_path:
+                        # packed block: counts are already on host from
+                        # the write-side flush — skip empty partitions
+                        # without touching the device at all
+                        counts, starts = item[1], item[2]
+                        n = int(counts[p])
+                        if n == 0:
+                            continue
                     # promotion of a spilled map-output batch is an
                     # allocation: route it through the retry framework
                     try:
@@ -483,6 +600,20 @@ class TpuShuffleExchangeExec(TpuExec):
                             "peer's corruption recovery — re-reading "
                             "from the re-executed write",
                             site="exchange.read") from gone
+                    if device_path:
+                        # slice the contiguous row range out of the
+                        # packed block; count is a HOST int already, so
+                        # the yielded batch needs no num_rows sync
+                        try:
+                            out = self._packed_slice_kernel(
+                                b, jnp.int32(int(starts[p])),
+                                jnp.int32(n), metrics=self.metrics)
+                        finally:
+                            fw.release_batch(buf_id)
+                        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                        yield DeviceBatch(out.schema, out.columns, n)
+                        continue
+                    rr_start = item[1]
                     try:
                         outs.append(self._slice_kernel(
                             b, pids_of(buf_id, b, rr_start),

@@ -380,19 +380,37 @@ class DeviceToHostExec(TpuExec):
             def it():
                 import time as _time
 
-                for db in child_data.iterator(pid):
+                from ..data.column import device_to_host_many
+
+                # chunked drain: one batched download per K batches —
+                # a per-batch device_to_host pays 2 device RTTs each,
+                # the dominant wall of a small-batch result stream over
+                # a remote link.  K bounds how many device batches the
+                # chunk pins at once.
+                chunk = []
+
+                def drain():
                     t0 = _time.perf_counter_ns()
                     with trace_range("DeviceToHost",
                                      self.metrics[M.TOTAL_TIME]):
-                        hb = device_to_host(db)
+                        hbs = device_to_host_many(chunk)
                     sync = self.metrics.get(M.DEVICE_SYNC_TIME)
                     if sync is not None:  # telemetry-only metric
                         sync.add(_time.perf_counter_ns() - t0)
                     if sem:
                         sem.release_if_necessary()
-                    self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
-                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
-                    yield hb
+                    for hb in hbs:
+                        self.metrics[M.NUM_OUTPUT_ROWS].add(hb.num_rows)
+                        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                        yield hb
+                    chunk.clear()
+
+                for db in child_data.iterator(pid):
+                    chunk.append(db)
+                    if len(chunk) >= 8:
+                        yield from drain()
+                if chunk:
+                    yield from drain()
                 if sem:
                     sem.release_if_necessary()
 

@@ -13,6 +13,10 @@ Counters:
 * ``fault.numStageRetries``     — stage/leaf re-executions from lineage
 * ``fault.numChecksumFailures`` — CRC32C mismatches detected on read
 * ``fault.numWatchdogTrips``    — stage/queue watchdog deadlines hit
+* ``fault.numShuffleFallbacks`` — device-shuffle queries re-executed on
+  the host-staged shuffle rung (the ladder's device-shuffle →
+  host-shuffle step; orthogonal to ``degradeLevel``, whose numbering
+  is stable)
 * ``fault.degradeLevel``        — final ladder rung (0 = native plan,
   1 = single-process fallback, 2 = CPU-exec plan)
 """
@@ -27,7 +31,7 @@ DEGRADE_SINGLE_PROCESS = 1
 DEGRADE_CPU = 2
 
 _COUNTERS = ("numStageRetries", "numChecksumFailures",
-             "numWatchdogTrips", "degradeLevel")
+             "numWatchdogTrips", "numShuffleFallbacks", "degradeLevel")
 
 
 class FaultStats:
@@ -69,6 +73,4 @@ def fault_summary(metric_snapshot) -> str:
     vals = {k: metric_snapshot.get(k, 0) for k in keys}
     if not any(vals.values()):
         return ""
-    return ("numStageRetries=%d numChecksumFailures=%d "
-            "numWatchdogTrips=%d degradeLevel=%d"
-            % tuple(vals[k] for k in keys))
+    return " ".join(f"{k}={vals[f'fault.{k}']}" for k in _COUNTERS)

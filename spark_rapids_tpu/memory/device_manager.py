@@ -178,6 +178,15 @@ class DeviceManager:
         with self._alloc_lock:
             self._reserved = max(0, self._reserved - nbytes)
 
+    def headroom(self) -> int:
+        """Unallocated logical-arena bytes (may be negative while the
+        spiller catches up) — the ``shuffle.mode=auto`` admission
+        signal: a device-resident shuffle write only starts while the
+        arena has room, otherwise it degrades to the host-staged
+        path up front instead of thrashing the spiller."""
+        with self._alloc_lock:
+            return self.arena_bytes - self._allocated
+
     @property
     def reserved_bytes(self) -> int:
         return self._reserved

@@ -395,6 +395,19 @@ class SpillFramework:
                 self.host_queue.remove(buf.id)
             self.catalog.remove(buf_id)
 
+    def stage_to_host(self, buf_id: int) -> int:
+        """Eagerly demote one DEVICE-tier buffer to the host tier (the
+        host-staged shuffle path: every map-output block is serialized
+        + CRC32C-stamped immediately instead of waiting for memory
+        pressure).  Full ``_demote_to_host`` accounting applies — spill
+        metrics, the ``spill`` event, listener fan-out.  Returns bytes
+        staged (0 when the buffer is gone or already off-device)."""
+        with self._lock:
+            buf = self.catalog.get(buf_id)
+            if buf is None or buf.tier != StorageTier.DEVICE:
+                return 0
+            return self._demote_to_host(buf)
+
     # ----- spilling --------------------------------------------------------
     def spill_device_to_target(self, target_bytes: int) -> int:
         """Spill lowest-priority unpinned device buffers until device

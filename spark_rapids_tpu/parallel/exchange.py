@@ -172,9 +172,18 @@ def unsqueeze_leading(b: DeviceBatch) -> DeviceBatch:
 def exchange_step(mesh, fn):
     """Wrap ``fn(local_batch) -> local_batch`` (which may call
     collective_exchange) in shard_map over the mesh's data axis,
-    operating on stacked [n_parts, ...] DeviceBatch pytrees."""
+    operating on stacked [n_parts, ...] DeviceBatch pytrees.
+
+    The returned callable is a Python-level dispatcher (not the raw
+    shard_map program): every collective dispatch polls the query's
+    cancellation token first — a cancelled query must stop at the next
+    exchange instead of joining a mesh-wide collective its peers will
+    wait on — and its wall clock accrues to
+    ``shuffle.collectiveTime``."""
     from jax.sharding import PartitionSpec as P
 
+    from ..scheduler.cancel import check_cancel
+    from ..shuffle.device_shuffle import collective_timer
     from ._compat import get_shard_map
 
     shard_map = get_shard_map()
@@ -184,8 +193,15 @@ def exchange_step(mesh, fn):
     def per_shard(stacked: DeviceBatch) -> DeviceBatch:
         return unsqueeze_leading(fn(squeeze_leading(stacked)))
 
-    return shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+    step = shard_map(per_shard, mesh=mesh, in_specs=P(axis),
                      out_specs=P(axis))
+
+    def dispatch(stacked: DeviceBatch) -> DeviceBatch:
+        check_cancel("shuffle.collective")
+        with collective_timer():
+            return step(stacked)
+
+    return dispatch
 
 
 def stack_partitions(batches: List[DeviceBatch]) -> DeviceBatch:

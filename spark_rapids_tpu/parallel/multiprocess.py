@@ -278,7 +278,15 @@ class MultiProcessRunner(DistributedRunner):
         stats = np.asarray([local_rows]
                            + [local_w[ci] for ci in str_cols],
                            dtype=np.int64)
-        agreed = multihost_utils.process_allgather(stats).max(axis=0)
+        # cross-controller collective: poll cancellation BEFORE joining
+        # (a cancelled controller entering an allgather wedges every
+        # peer) and bill the wall to shuffle.collectiveTime
+        from ..scheduler.cancel import check_cancel
+        from ..shuffle.device_shuffle import collective_timer
+
+        check_cancel("shuffle.collective")
+        with collective_timer():
+            agreed = multihost_utils.process_allgather(stats).max(axis=0)
         bucket = bucket_rows(max(int(agreed[0]), 1), self.min_bucket)
         widths = {ci: int(w) for ci, w in zip(str_cols, agreed[1:])}
 
@@ -404,7 +412,13 @@ class MultiProcessRunner(DistributedRunner):
     def _collect_output(self, out: DeviceBatch, stages) -> HostBatch:
         from jax.experimental import multihost_utils
 
-        gathered = multihost_utils.process_allgather(out, tiled=True)
+        from ..scheduler.cancel import check_cancel
+        from ..shuffle.device_shuffle import collective_timer
+
+        check_cancel("shuffle.collective")
+        with collective_timer():
+            gathered = multihost_utils.process_allgather(out,
+                                                         tiled=True)
         # gathered leaves are full global numpy arrays [n, ...]
         parts = X.unstack_partitions(gathered)
         host = [device_to_host(p) for p in parts]
@@ -454,9 +468,16 @@ def run_distributed_mp(session, df, mesh) -> HostBatch:
     finally:
         from ..fault.stats import GLOBAL as _fault_stats
 
+        from ..shuffle.device_shuffle import GLOBAL as _shuffle_stats
+
         session.last_metrics = dict(
             getattr(session, "last_metrics", None) or {})
         session.last_metrics.update(_fault_stats.snapshot())
+        # per-run collective wall/bytes (the dispatch wrappers above
+        # accrue into the process-global stats; the ExecContext mark
+        # scopes the delta to THIS run)
+        session.last_metrics.update(_shuffle_stats.metrics_since(
+            getattr(ctx, "shuffle_stats_mark", None)))
         from ..telemetry import finish_query
 
         finish_query(session, ctx, phys=phys)

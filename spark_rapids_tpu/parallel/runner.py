@@ -925,6 +925,8 @@ class DistributedRunner:
         import jax
         from jax.sharding import PartitionSpec as P
 
+        from ..scheduler.cancel import check_cancel
+        from ..shuffle.device_shuffle import collective_timer
         from ._compat import get_shard_map
 
         shard_map = get_shard_map()
@@ -967,7 +969,16 @@ class DistributedRunner:
                 per_shard, mesh=self.mesh,
                 in_specs=(spec,) * len(ins),
                 out_specs=(spec, (P(),) * len(aux_keys))))
-            out, aux_vals = spmd(*ins)
+            # same dispatch discipline as exchange_step: a cancelled
+            # query must not join a mesh-wide collective its peers
+            # will wait on, and the dispatch wall of an
+            # exchange-bearing program accrues to shuffle.collectiveTime
+            check_cancel("shuffle.collective")
+            if post is not None or self._has_collective(root):
+                with collective_timer():
+                    out, aux_vals = spmd(*ins)
+            else:
+                out, aux_vals = spmd(*ins)
             overflow = False
             for k, v in zip(aux_keys, aux_vals):
                 total = int(np.asarray(v))
@@ -977,6 +988,25 @@ class DistributedRunner:
             if not overflow:
                 return out
         raise RuntimeError("collective capacity retries exhausted")
+
+    @staticmethod
+    def _has_collective(node) -> bool:
+        """True when lowering ``node`` dispatches a mesh collective (a
+        shuffle exchange inside the program).  Precomputed broadcast
+        replicates run as their own program and are timed there via
+        ``post``; the rare inline nested-build replicate rides along
+        untimed rather than tagging every broadcast-join stage."""
+        from ..exec.exchange import TpuShuffleExchangeExec
+
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, tuple):
+                op, *kids = n
+                if isinstance(op, TpuShuffleExchangeExec):
+                    return True
+                stack.extend(kids)
+        return False
 
     def _prepare_broadcasts(self, stage: _Stage, env_stacked: Dict,
                             caps: Dict) -> None:
@@ -1105,6 +1135,10 @@ def run_distributed(session, df, mesh=None, n_devices: int = 8
         session.last_metrics = dict(
             getattr(session, "last_metrics", None) or {})
         session.last_metrics.update(_fault_stats.snapshot())
+        from ..shuffle.device_shuffle import GLOBAL as _shuffle_stats
+
+        session.last_metrics.update(_shuffle_stats.metrics_since(
+            getattr(ctx, "shuffle_stats_mark", None)))
         from ..telemetry import finish_query
 
         # profile metrics default to THIS query's ctx snapshot — the

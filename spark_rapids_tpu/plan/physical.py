@@ -55,12 +55,16 @@ class ExecContext:
     token, bound to the creating thread the same way."""
 
     def __init__(self, conf, session=None, *, scheduled: bool = False,
-                 cancel_token=None):
+                 cancel_token=None, force_host_shuffle: bool = False):
         self.conf = conf
         self.session = session
         self.metrics = MetricsRegistry()
         self.scheduled = scheduled
         self.cancel_token = cancel_token
+        #: the ladder's host-shuffle rung: a re-execution with this set
+        #: forces every exchange onto the host-staged path regardless
+        #: of shuffle.mode (see Session._execute_host_shuffle_rung)
+        self.force_host_shuffle = force_host_shuffle
         #: shuffle ids registered during this query, freed at query end
         #: (reference: per-shuffle cleanup, ShuffleBufferCatalog.scala)
         self.shuffle_ids: List[int] = []
@@ -111,6 +115,11 @@ class ExecContext:
         from ..exec.kernel_cache import GLOBAL as _kernel_cache
 
         self.kernel_cache_mark = _kernel_cache.counters()
+        # shuffle-stats snapshot — same delta-reporting discipline as
+        # the kernel cache (session merges metrics_since at query end)
+        from ..shuffle.device_shuffle import GLOBAL as _shuffle_stats
+
+        self.shuffle_stats_mark = _shuffle_stats.counters()
 
 
 class PartitionedData:

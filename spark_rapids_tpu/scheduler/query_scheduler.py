@@ -462,6 +462,19 @@ class QueryScheduler:
         from ..plan.physical import ExecContext, collect_batches
         from ..telemetry.events import emit_event
 
+        # Same zero-leak discipline as the cancellation unwind: the
+        # failed attempt's frames (held by cause.__traceback__ and its
+        # context chain) pin the attempt's exec tree — and with it any
+        # upload cache the attempt already published — so strip them
+        # BEFORE the cause reaches a log record that may retain it,
+        # and drop the dead attempt's caches deterministically.
+        cause.__cause__ = None
+        cause.__context__ = None
+        cause = cause.with_traceback(None)
+        failed_phys = sink.get("phys")
+        if failed_phys is not None:
+            self._drop_upload_caches(failed_phys)
+
         emit_event("degrade", level=DEGRADE_CPU, rung="cpu",
                    cause=type(cause).__name__, scheduled=True,
                    query_id=handle.query_id)

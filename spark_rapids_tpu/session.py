@@ -185,7 +185,8 @@ class Session:
         return phys
 
     def prepare_execution(self, plan: L.LogicalPlan, *,
-                          scheduled: bool = False, cancel_token=None):
+                          scheduled: bool = False, cancel_token=None,
+                          force_host_shuffle: bool = False):
         """Plan + capture + context — the shared front half of execute
         paths (incl. the ML columnar export).
 
@@ -219,7 +220,8 @@ class Session:
         if self.capture_plans:
             self._executed_plans.append(phys)
         ctx = ExecContext(self.conf, self, scheduled=scheduled,
-                          cancel_token=cancel_token)
+                          cancel_token=cancel_token,
+                          force_host_shuffle=force_host_shuffle)
         ctx.kernel_cache_mark = kc_mark
         return phys, ctx
 
@@ -236,11 +238,22 @@ class Session:
         try:
             return self._execute_native(plan)
         except TpuFaultError as e:
-            from .config import FAULT_DEGRADE_ENABLED
+            from .config import FAULT_DEGRADE_ENABLED, SHUFFLE_MODE
 
             if self.device_manager is None or \
                     not self.conf.get(FAULT_DEGRADE_ENABLED):
                 raise
+            # ladder rung between native and CPU: re-execute with every
+            # exchange forced onto the host-staged shuffle path — the
+            # recovery for faults confined to the device-resident data
+            # path (a device-targeted corruption drill, HBM exhaustion
+            # during a packed write).  Skipped when the conf already
+            # pins host shuffle (the rung would change nothing).
+            if (self.conf.get(SHUFFLE_MODE) or "auto").lower() != "host":
+                try:
+                    return self._execute_host_shuffle_rung(plan, e)
+                except TpuFaultError as e2:
+                    return self._execute_degraded_cpu(plan, e2)
             return self._execute_degraded_cpu(plan, e)
 
     def _finalize_metrics(self, ctx, phys=None,
@@ -271,9 +284,12 @@ class Session:
                 # drill must not leak into this query's metrics
                 merged.update(_fault_stats.snapshot())
             from .exec.kernel_cache import GLOBAL as _kernel_cache
+            from .shuffle.device_shuffle import GLOBAL as _shuffle_stats
 
             merged.update(_kernel_cache.metrics_since(
                 getattr(ctx, "kernel_cache_mark", None)))
+            merged.update(_shuffle_stats.metrics_since(
+                getattr(ctx, "shuffle_stats_mark", None)))
             fsum = fault_summary(merged)
             if fsum:
                 log.warning(
@@ -297,9 +313,11 @@ class Session:
 
     def _execute_native(self, plan: L.LogicalPlan, *,
                         scheduled: bool = False, cancel_token=None,
-                        ctx_sink: Optional[Dict] = None) -> HostBatch:
+                        ctx_sink: Optional[Dict] = None,
+                        force_host_shuffle: bool = False) -> HostBatch:
         phys, ctx = self.prepare_execution(
-            plan, scheduled=scheduled, cancel_token=cancel_token)
+            plan, scheduled=scheduled, cancel_token=cancel_token,
+            force_host_shuffle=force_host_shuffle)
         if ctx_sink is not None:
             ctx_sink["phys"] = phys
             ctx_sink["ctx"] = ctx
@@ -320,6 +338,76 @@ class Session:
             if self.shuffle_catalog is not None:
                 for sid in ctx.shuffle_ids:
                     self.shuffle_catalog.unregister_shuffle(sid)
+
+    def _execute_host_shuffle_rung(self, plan: L.LogicalPlan,
+                                   cause) -> HostBatch:
+        """The device-shuffle → host-shuffle ladder rung: re-execute
+        the whole query natively with every exchange forced onto the
+        host-staged path.  Injectors stay ARMED (re-armed from conf by
+        the new ExecContext) — a drill that also hits the host path
+        fails this rung and falls through to the CPU rung.  Fault
+        counters from the failed device attempt stay visible in
+        ``last_metrics`` whether this rung succeeds or not."""
+        from .fault.errors import TpuFaultError
+        from .fault.stats import GLOBAL as _fault_stats
+        from .fault.stats import fault_summary
+        from .telemetry.events import emit_event
+
+        # the failed attempt's counters were finalized into
+        # last_metrics by _execute_native's finally — carry them
+        prior = {k: v for k, v in (self.last_metrics or {}).items()
+                 if k.startswith(("fault.", "retry."))}
+        prior["fault.numShuffleFallbacks"] = \
+            prior.get("fault.numShuffleFallbacks", 0) + 1
+
+        def _emit_rung_events():
+            # emitted AFTER the rung's execution: the telemetry binding
+            # then points at the rung's own profile (the final
+            # last_profile), not the already-finished device attempt's
+            emit_event("shuffle_fallback", reason="ladder",
+                       cause=type(cause).__name__)
+            emit_event("degrade", rung="host-shuffle",
+                       cause=type(cause).__name__)
+
+        log.warning(
+            "native execution exhausted fault recovery (%s: %s) — "
+            "re-executing on the host-staged shuffle rung",
+            type(cause).__name__, cause)
+
+        def _merge_prior():
+            merged = dict(self.last_metrics)
+            for k, v in prior.items():
+                if k == "fault.degradeLevel":
+                    merged[k] = max(merged.get(k, 0), v)
+                else:
+                    merged[k] = merged.get(k, 0) + v
+            self.last_metrics = merged
+
+        try:
+            out = self._execute_native(plan, force_host_shuffle=True)
+        except TpuFaultError:
+            # keep the device attempt (and this rung's fallback count)
+            # visible to the CPU rung: both in last_metrics and in the
+            # process-global stats its finalize snapshots (the CPU
+            # rung's session-less context never resets them)
+            _merge_prior()
+            _fault_stats.add("numShuffleFallbacks")
+            _emit_rung_events()
+            raise
+        _merge_prior()
+        _fault_stats.add("numShuffleFallbacks")
+        _emit_rung_events()
+        from .config import TELEMETRY_ENABLED
+
+        if self.last_profile is not None \
+                and self.conf.get(TELEMETRY_ENABLED):
+            self.last_profile.metrics = dict(self.last_metrics)
+        fsum = fault_summary(self.last_metrics)
+        if fsum:
+            log.warning(
+                "query recovered on the host-shuffle rung DEGRADED: %s",
+                fsum)
+        return out
 
     def _execute_degraded_cpu(self, plan: L.LogicalPlan,
                               cause) -> HostBatch:

@@ -1,0 +1,70 @@
+"""Worker entry for the 2-process device-shuffle test (NOT pytest).
+
+Each OS process joins the multi-controller job and runs the SAME seeded
+join+agg plan through MultiProcessRunner twice — once with
+``shuffle.mode=device`` and once with ``shuffle.mode=host`` — and
+compares both against the single-process CPU oracle.  The collective
+exchange path (shard_map all-to-all over the mesh) must place every row
+identically whichever way the map-side blocks are held, and the
+``shuffle.collectiveTime`` wall must accrue from the dispatch wrapper.
+
+Run by tests/test_device_shuffle.py as:
+
+    python tests/mp_shuffle_worker.py <coordinator> <nprocs> <pid>
+"""
+import sys
+
+
+def main():
+    coordinator, nprocs, pid = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]))
+
+    from spark_rapids_tpu.parallel.multiprocess import (
+        init_multiprocess, run_distributed_mp)
+
+    mesh = init_multiprocess(coordinator, nprocs, pid,
+                             local_cpu_devices=4)
+
+    import numpy as np
+
+    from spark_rapids_tpu import Session
+    from spark_rapids_tpu.plan import functions as F
+
+    rng = np.random.RandomState(321)
+    orders = {"o_custkey": rng.randint(0, 60, 500),
+              "o_total": (rng.rand(500) * 1000).round(6)}
+    cust = {"c_custkey": np.arange(60),
+            "c_nation": rng.randint(0, 6, 60)}
+
+    def q(sess):
+        o = sess.create_dataframe(dict(orders))
+        c = sess.create_dataframe(dict(cust))
+        j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+        return j.group_by("c_nation").agg(
+            F.sum("o_total").alias("rev"), F.count("o_total").alias("n"))
+
+    cpu = Session(tpu_enabled=False)
+    want = sorted(q(cpu).collect())
+
+    for mode in ("device", "host"):
+        conf = {
+            "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+            "spark.rapids.tpu.shuffle.mode": mode,
+        }
+        sess = Session(conf)
+        got = sorted(run_distributed_mp(sess, q(sess), mesh).to_rows())
+        assert len(got) == len(want), (mode, len(got), len(want))
+        for g, w in zip(got, want):
+            assert g[0] == w[0] and g[2] == w[2], (mode, g, w)
+            assert abs(g[1] - w[1]) < 1e-6 * max(1.0, abs(w[1])), \
+                (mode, g, w)
+        wall = sess.last_metrics.get("shuffle.collectiveTimeNs", 0)
+        assert wall > 0, (mode, sess.last_metrics)
+        print(f"MPS MODE OK pid={pid} mode={mode} rows={len(got)} "
+              f"collectiveNs={wall}", flush=True)
+
+    print(f"MPS RESULT OK pid={pid} rows={len(want)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

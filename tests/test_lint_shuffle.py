@@ -1,0 +1,157 @@
+"""AST lint: device-resident shuffle data-path discipline.
+
+The device exchange's whole value is that partition blocks never leave
+HBM until something (spill pressure, the host-staged mode, the ladder)
+explicitly demands it.  One stray host readback in the hot path —
+``jax.device_get``, ``np.asarray`` on a device array, ``.item()`` —
+reintroduces a per-block d2h sync and silently erases the win.  Same
+for the mesh collectives: every Python-level collective dispatch is a
+mesh-wide rendezvous, so it must poll cooperative cancellation first
+(a cancelled query joining a collective wedges every peer).  Both
+properties are enforced mechanically:
+
+1. **No host materialization in the shuffle hot path** — in
+   ``shuffle/device_shuffle.py`` and ``exec/exchange.py``, calls that
+   synchronously pull device data to the host (``device_get``,
+   ``np.asarray``, ``.tolist()``, ``.item()``, ``device_to_host``,
+   ``to_host``) may appear only inside the explicitly gated sync
+   points: ``fetch_counts`` (the ONE batched count readback),
+   ``flush`` (which calls it), and ``drain_outs`` (the legacy
+   host-path reader drain) — or in the allowlist below with a reason.
+2. **Collective dispatch sites poll cancellation** — the
+   ``exchange_step`` dispatcher (parallel/exchange.py) and every
+   function in ``parallel/`` that dispatches ``process_allgather``
+   must call ``check_cancel`` in the same function body.
+"""
+import ast
+import os
+
+PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "spark_rapids_tpu")
+
+HOT_PATH_FILES = (os.path.join("shuffle", "device_shuffle.py"),
+                  os.path.join("exec", "exchange.py"))
+
+#: functions that ARE the gated host-sync points of the data path
+GATED_FUNCS = {"fetch_counts", "flush", "drain_outs"}
+
+#: names whose call synchronously materializes device data on the host
+HOST_SYNC_NAMES = {"device_get", "tolist", "item",
+                   "device_to_host", "to_host"}
+
+POLL_NAMES = {"check_cancel"}
+
+#: "<relpath>:<lineno>" -> reason.  Keep this SHORT — an entry here is
+#: a host sync on the device shuffle hot path.
+ALLOWLIST = {}
+
+
+def _terminal_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _calls_in(node):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield _terminal_name(n.func)
+
+
+def _is_host_sync(call: ast.Call) -> bool:
+    name = _terminal_name(call.func)
+    if name in HOST_SYNC_NAMES:
+        return True
+    # np.asarray(x) forces a device array onto the host; jnp.asarray
+    # stays on device and is fine
+    if name == "asarray" and isinstance(call.func, ast.Attribute) \
+            and isinstance(call.func.value, ast.Name) \
+            and call.func.value.id == "np":
+        return True
+    return False
+
+
+def _functions_with_calls(tree):
+    """Yield (funcdef, calls-directly-inside) with nested functions
+    attributed to THEMSELVES, not their enclosing def."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        own = []
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested def owns its body
+            if isinstance(n, ast.Call):
+                own.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        yield node, own
+
+
+def test_no_host_materialization_on_the_device_shuffle_hot_path():
+    offenders, checked = [], 0
+    for rel in HOT_PATH_FILES:
+        path = os.path.join(PKG, rel)
+        tree = ast.parse(open(path).read(), filename=path)
+        for func, calls in _functions_with_calls(tree):
+            checked += 1
+            if func.name in GATED_FUNCS:
+                continue
+            for call in calls:
+                if not _is_host_sync(call):
+                    continue
+                key = f"{rel}:{call.lineno}"
+                if key in ALLOWLIST:
+                    continue
+                offenders.append(
+                    f"{key} in {func.name}(): "
+                    f"{_terminal_name(call.func)}")
+    assert checked >= 10, "lint scanned suspiciously few functions"
+    assert not offenders, (
+        "host materialization on the device shuffle hot path (move it "
+        "behind fetch_counts/flush/drain_outs or allowlist with a "
+        "reason):\n" + "\n".join(offenders))
+
+
+def test_exchange_step_dispatcher_polls_cancellation():
+    path = os.path.join(PKG, "parallel", "exchange.py")
+    tree = ast.parse(open(path).read(), filename=path)
+    found = 0
+    for func, _calls in _functions_with_calls(tree):
+        if func.name != "exchange_step":
+            continue
+        found += 1
+        # the poll lives in the nested dispatcher; scan the whole def
+        names = set(_calls_in(func))
+        assert names & POLL_NAMES, (
+            "exchange_step must poll check_cancel before dispatching "
+            "the collective")
+    assert found == 1, "exchange_step not found — lint out of date"
+
+
+def test_collective_dispatch_sites_poll_cancellation():
+    base = os.path.join(PKG, "parallel")
+    offenders, checked = [], 0
+    for fn in sorted(os.listdir(base)):
+        if not fn.endswith(".py"):
+            continue
+        rel = os.path.join("parallel", fn)
+        path = os.path.join(base, fn)
+        tree = ast.parse(open(path).read(), filename=path)
+        for func, calls in _functions_with_calls(tree):
+            names = [_terminal_name(c.func) for c in calls]
+            if "process_allgather" not in names:
+                continue
+            checked += 1
+            if not (set(names) & POLL_NAMES):
+                offenders.append(f"{rel}: {func.name}()")
+    assert checked >= 2, (
+        "lint found fewer process_allgather dispatch sites than the "
+        "known minimum — update the lint if the sites moved")
+    assert not offenders, (
+        "collective dispatch without a cancellation poll in the same "
+        "function:\n" + "\n".join(offenders))
