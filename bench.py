@@ -460,6 +460,55 @@ def _q6_scan_breakdown(raw, iters=3):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _aqe_decisions(metrics):
+    """The aqe.num* decision counters from a finished query's metrics
+    (how many joins converted / partitions coalesced / skew splits the
+    adaptive driver actually performed)."""
+    return {k.split(".", 1)[1]: int(v) for k, v in (metrics or {}).items()
+            if k.startswith("aqe.num")}
+
+
+def _aqe_exchange_delta(raw, deadline=None):
+    """AQE satellite: q3/q5 wall and exchange wall, adaptive on vs
+    off, on force-shuffled plans (the static broadcast shortcut at
+    this scale factor would leave dynamic conversion nothing to do).
+    The decision counts ride along so a delta is attributable to
+    specific rewrites rather than noise."""
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.session import Session
+
+    def exchange_wall_s(m):
+        return sum(v for k, v in (m or {}).items()
+                   if "ShuffleExchangeExec" in k
+                   and k.endswith("totalTime")) / 1e9
+
+    out = {}
+    for qn in (3, 5):
+        rec = {}
+        for mode, enabled in (("adaptive", True), ("static", False)):
+            sess = Session({
+                **PRESSURE_CONF,
+                "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+                "spark.rapids.tpu.sql.adaptive.enabled": enabled})
+            tables = {name: sess.create_dataframe(
+                {c: v for c, v in cols.items()}, schema)
+                for name, (schema, cols) in raw.items()}
+            df = tpch.QUERIES[qn](tables)
+            df.collect()  # compile-inclusive warmup
+            wall, _ = _best(lambda: df.collect(), iters=3, warmup=0,
+                            deadline=deadline)
+            m = sess.last_metrics or {}
+            rec[mode] = {"wall_s": round(wall, 4),
+                         "exchange_wall_s": round(exchange_wall_s(m), 4)}
+            if enabled:
+                rec["decisions"] = _aqe_decisions(m)
+        rec["exchange_delta_s"] = round(
+            rec["static"]["exchange_wall_s"]
+            - rec["adaptive"]["exchange_wall_s"], 4)
+        out[f"q{qn}"] = rec
+    return out
+
+
 def _ooc_bench(raw, sizes, deadline):
     """Out-of-core perf: TPC-H q3 (the query that blew the r4 budget)
     under OOC_CONF, so the grace-join/chunked-agg machinery gets a
@@ -790,6 +839,7 @@ def child_main(platform):
             "cpu_best_s": round(cpu_s, 4),
             "cpu_engine": "host" if host_s <= pd_s else "pandas",
             "speedup": round(cpu_s / tpu_s, 2),
+            "aqe": _aqe_decisions(m),
             **split,
         }
         per_query[f"q{qn}"] = rec
@@ -818,6 +868,15 @@ def child_main(platform):
     q6_scan = _q6_scan_breakdown(raw) if remaining > 25 else None
     if q6_scan is not None:
         _emit({"progress": "q6_scan", **q6_scan})
+    remaining = _deadline() - time.perf_counter()
+    aqe_delta = None
+    if remaining > 45:
+        try:
+            aqe_delta = _aqe_exchange_delta(
+                raw, deadline=_deadline() - 20)
+        except Exception as e:  # noqa: BLE001 - never lose the summary
+            aqe_delta = {"error": f"{type(e).__name__}: {e}"[:200]}
+        _emit({"progress": "aqe_delta", **aqe_delta})
     remaining = _deadline() - time.perf_counter()
     ooc = None
     if remaining > 60:
@@ -870,6 +929,7 @@ def child_main(platform):
         "shuffle_write": shuffle,
         "q3_exchange": q3_exchange,
         "q6_scan": q6_scan,
+        "aqe_delta": aqe_delta,
         "ooc": ooc,
         "tpcxbb_mini": tpcxbb_mini,
         "q1_pipeline": q1p,

@@ -1,0 +1,36 @@
+"""Adaptive query execution (AQE).
+
+Reference analogue: Spark 3.0's AdaptiveSparkPlanExec +
+ShufflePartitionsUtil/OptimizeSkewedJoin/DynamicJoinSelection — the
+not-yet-executed remainder of a physical plan is re-optimized from
+EXACT statistics materialized at shuffle boundaries, instead of the
+static estimates the planner had at plan time (SURVEY §1:
+GpuShuffleExchangeExec participates in AQE stage re-planning; Theseus
+makes the same argument for accelerator SQL: data-movement decisions
+must come from observed, not estimated, sizes).
+
+Three pieces:
+
+* :mod:`.stats` — ``StageStats``: per-exchange partition histograms
+  aggregated from the count vectors the device shuffle's write drain
+  already pulls to the host in its ONE gated readback
+  (``exec/exchange.py``'s ``flush``).  Zero extra device syncs — this
+  module never imports jax (``tests/test_lint_adaptive.py`` enforces
+  it mechanically).
+* :mod:`.planner` — ``AdaptivePlanner``: the three rewrites applied to
+  the unexecuted plan suffix between stages — partition coalescing,
+  skew-join splitting, dynamic broadcast conversion — each recorded as
+  a structured ``aqe_*`` telemetry event.
+* :mod:`.executor` — ``maybe_execute_adaptive``: the stage-at-a-time
+  driver hooked into ``Session._execute_native``.  It materializes the
+  deepest exchanges eagerly (build side of a shuffled join first, so a
+  conversion can still skip the stream-side exchange entirely),
+  replaces each with a ``MaterializedStageExec`` over the resident
+  shuffle output, re-plans, and repeats; the final plan is annotated
+  AdaptiveSparkPlan-style in EXPLAIN ANALYZE.
+
+Every rewrite is bit-identical to the non-adaptive plan: same values,
+same row placement after the re-partitioning rules — pinned on TPC-H
+including under fault injection and concurrent ``session.submit``.
+"""
+from .stats import StageStats  # noqa: F401

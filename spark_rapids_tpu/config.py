@@ -134,8 +134,44 @@ def dump_markdown() -> str:
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
     lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC,
               "", _SCHEDULING_DOC, "", _OBSERVABILITY_DOC, "",
-              _PERF_TUNING_DOC, "", _SHUFFLE_DOC]
+              _PERF_TUNING_DOC, "", _SHUFFLE_DOC, "", _ADAPTIVE_DOC]
     return "\n".join(lines)
+
+
+_ADAPTIVE_DOC = """\
+## Adaptive query execution
+
+The `adaptive.*` confs (table above) configure the AQE subsystem
+(`spark_rapids_tpu/adaptive/`, docs/adaptive.md):
+
+* **Runtime stage statistics** — the device shuffle's write drain
+  already pulls per-partition count vectors to the host in its one
+  gated batch readback; `StageStats` aggregates them (plus block byte
+  sizes from the arena accounting) into exact per-exchange partition
+  histograms with ZERO extra device syncs (lint-enforced), surfaced as
+  `shuffle.exchange<N>.partRows{Min,P50,Max}`/`skewPct` in
+  `Session.last_metrics`, `profile_report()` and the Prometheus export
+  even with `adaptive.enabled=false`.
+* **Partition coalescing** — adjacent small post-shuffle partitions
+  are merged up to `adaptive.targetPartitionBytes`, shrinking reader
+  fan-in; both sides of a co-partitioned join get the identical
+  grouping.
+* **Skew-join splitting** — a partition exceeding
+  `adaptive.skewedPartitionFactor` x the median rows (and
+  `adaptive.skewedPartitionThresholdBytes`) is cut into contiguous
+  row-balanced sub-slices, each joined against a replica of the full
+  build-side partition — the straggler that used to eat the whole
+  stage wall (and trip the stage watchdogs) becomes parallel work.
+* **Dynamic broadcast conversion** — a planned shuffled-hash join
+  whose MATERIALIZED build side lands under
+  `adaptive.autoBroadcastJoinThreshold` is demoted to a broadcast
+  join, skipping the stream-side exchange entirely.
+* Every decision emits a structured `aqe_*` telemetry event, the
+  final plan renders AdaptiveSparkPlan-style in EXPLAIN ANALYZE, and
+  the scheduler's per-query HBM reservation is re-based from observed
+  stage output.  All rewrites are bit-identical to the non-adaptive
+  plan — same values, same row placement after the re-partitioning
+  rules — including under fault injection and concurrent submit."""
 
 
 _SHUFFLE_DOC = """\
@@ -648,6 +684,48 @@ SHUFFLE_TARGET_BATCH_ROWS = conf(
     "Exchange writes coalesce sub-target input batches up to this many "
     "rows before the partition-build kernel runs, so a stream of tiny "
     "batches costs one build dispatch instead of N").int_conf(32768)
+
+# --- adaptive query execution (adaptive/; reference: Spark 3.0 AQE —
+# AdaptiveSparkPlanExec + ShufflePartitionsUtil + OptimizeSkewedJoin +
+# DynamicJoinSelection, re-planned from exact shuffle stats) ---------------
+ADAPTIVE_ENABLED = conf("spark.rapids.tpu.sql.adaptive.enabled").doc(
+    "Adaptive query execution: re-optimize the unexecuted plan suffix "
+    "between stages from exact materialized shuffle statistics — "
+    "partition coalescing, skew-join splitting and dynamic broadcast "
+    "conversion.  Rewrites are bit-identical to the static plan; "
+    "decisions are recorded as aqe_* telemetry events and rendered in "
+    "EXPLAIN ANALYZE").boolean_conf(True)
+ADAPTIVE_TARGET_PARTITION_BYTES = conf(
+    "spark.rapids.tpu.sql.adaptive.targetPartitionBytes").doc(
+    "Post-shuffle partition coalescing target: adjacent partitions "
+    "whose combined estimated bytes stay under this are merged into "
+    "one reader partition (reference: "
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes)").long_conf(
+    64 * 1024 * 1024)
+ADAPTIVE_AUTO_BROADCAST_THRESHOLD = conf(
+    "spark.rapids.tpu.sql.adaptive.autoBroadcastJoinThreshold").doc(
+    "Max OBSERVED build-side bytes for demoting a planned "
+    "shuffled-hash join to a broadcast join at runtime, skipping the "
+    "stream-side exchange (reference: the runtime re-check of "
+    "spark.sql.autoBroadcastJoinThreshold inside AQE; 0 disables "
+    "dynamic conversion)").long_conf(10 * 1024 * 1024)
+ADAPTIVE_SKEW_FACTOR = conf(
+    "spark.rapids.tpu.sql.adaptive.skewedPartitionFactor").doc(
+    "A join partition is skewed when its row count exceeds this factor "
+    "x the median partition rows (reference: "
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor)").double_conf(4.0)
+ADAPTIVE_SKEW_THRESHOLD_BYTES = conf(
+    "spark.rapids.tpu.sql.adaptive.skewedPartitionThresholdBytes").doc(
+    "Skew splitting additionally requires the skewed partition's "
+    "estimated bytes to exceed this floor, so tiny-but-lopsided "
+    "partitions are not split for nothing (reference: "
+    "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes)"
+).long_conf(64 * 1024 * 1024)
+ADAPTIVE_MAX_SKEW_SLICES = conf(
+    "spark.rapids.tpu.sql.adaptive.maxSkewSlices").doc(
+    "Upper bound on the contiguous sub-slices one skewed partition is "
+    "cut into (each slice replicates the build-side partition, so this "
+    "bounds the replication cost)").int_conf(8)
 
 # --- ML interop -----------------------------------------------------------
 EXPORT_COLUMNAR_RDD = conf("spark.rapids.tpu.sql.exportColumnarRdd").doc(

@@ -134,6 +134,22 @@ class BroadcastRegistry:
         for a in arts:
             a.free()
 
+    def free_key(self, key) -> None:
+        """Deterministically free one artifact (adaptive execution
+        frees its per-query dynamic-broadcast builds at query end —
+        their keys reference per-execution plan nodes and can never
+        match again)."""
+        try:
+            with self._lock:
+                art = self._arts.pop(key, None)
+                self._build_locks.pop(key, None)
+        except TypeError:
+            # the key's weakref died unhashed — the artifact (if any)
+            # is unreachable by lookup; the lazy dead-key purge frees it
+            return
+        if art is not None:
+            art.free()
+
     def clear(self) -> None:
         with self._lock:
             arts = list(self._arts.values())

@@ -129,7 +129,7 @@ def _free_shuffle_buffers(fw, store, spill_listener=None,
     if catalog is not None and shuffle_id is not None:
         catalog.unregister_shuffle(shuffle_id)  # idempotent
     else:
-        # entries are (buf_id, rr) on the host path and
+        # entries are (buf_id, rr, num_rows) on the host path and
         # (buf_id, counts, starts) on the device path
         for entry in (store[0] if store else ()):
             fw.remove_batch(entry[0])
@@ -254,6 +254,14 @@ class TpuShuffleExchangeExec(TpuExec):
         # it runs the legacy device-resident write, staging under host
         device_path = mode == "device" and not is_range
         store: List[list] = []
+        # AQE stage statistics: the write drain records its (already
+        # host-resident) per-block count vectors + byte sizes here —
+        # id allocated per EXECUTION so a re-drained retry overwrites
+        # with fresh numbers instead of appending stale ones
+        stage_stats = getattr(ctx, "stage_stats", None)
+        exchange_id = (stage_stats.allocate_id()
+                       if stage_stats is not None else 0)
+        stat_state = {"bytes": 0}
         # shuffle-scoped buffer group (reference: ShuffleBufferCatalog
         # shuffleId->mapId->buffers index + per-shuffle cleanup)
         catalog = shuffle_id = None
@@ -314,7 +322,7 @@ class TpuShuffleExchangeExec(TpuExec):
             import jax.numpy as jnp
 
             # device path: (buf_id, counts np, starts np)
-            # host path:   (buffer id, round-robin start offset)
+            # host path:   (buf_id, round-robin start offset, num_rows)
             items = []
             rr = 0
             samples = []   # host key samples for the range bounds
@@ -328,6 +336,7 @@ class TpuShuffleExchangeExec(TpuExec):
             # tile) — so a spill of a chunk member actually frees its HBM
             chunk = []
             rr_state["rr"] = jnp.int32(0)
+            stat_state["bytes"] = 0  # fresh per attempt (re-drains)
 
             def flush():
                 # ONE batched readback of the chunk's tiny per-block
@@ -348,6 +357,10 @@ class TpuShuffleExchangeExec(TpuExec):
                             continue
                         items.append((buf_id, counts,
                                       np.asarray(starts)))
+                        # arena-accounting block size: metadata math,
+                        # no device touch — AQE's byte estimate
+                        stat_state["bytes"] += int(
+                            device_sizes.get(buf_id, 0))
                     chunk.clear()
                     return
                 got = jax.device_get([(nr, samp)
@@ -359,7 +372,7 @@ class TpuShuffleExchangeExec(TpuExec):
                         continue
                     if samp is not None:
                         samples.append(np.asarray(samp))
-                    items.append((buf_id, rr))
+                    items.append((buf_id, rr, n))
                     rr = (rr + n) % self.n_out
                 chunk.clear()
 
@@ -411,6 +424,10 @@ class TpuShuffleExchangeExec(TpuExec):
                                                   b.num_rows,
                                                   dtype=jnp.int32),
                                               samp))
+                                # metadata-only size estimate (host
+                                # path has no packed-block accounting)
+                                stat_state["bytes"] += int(
+                                    b.device_bytes())
                             if len(chunk) >= 32:
                                 flush()
                     flush()
@@ -447,6 +464,15 @@ class TpuShuffleExchangeExec(TpuExec):
                         pid_cache[buf_id] = (
                             bid, self._bounds_pid_kernel(passes, bounds))
             store.append(items)
+            if stage_stats is not None:
+                # the numbers below are ALL host-resident already (the
+                # gated flush pulled them); recording is pure host math
+                stage_stats.record_exchange(
+                    exchange_id, items=items, n_out=self.n_out,
+                    device_path=device_path,
+                    total_bytes=stat_state["bytes"],
+                    partitioning=type(self.partitioning).__name__,
+                    name=self.describe())
 
         def materialized():
             """Shuffle write: batches registered as spillable in the
@@ -547,10 +573,61 @@ class TpuShuffleExchangeExec(TpuExec):
                 for bid in ids:
                     fw.remove_batch(bid)
 
-        def make(p):
+        def acquire_block(buf_id):
+            # promotion of a spilled map-output batch is an
+            # allocation: route it through the retry framework
+            try:
+                return R.retry_call(
+                    lambda bid=buf_id: fw.acquire_batch(bid),
+                    rctx)
+            except TpuPayloadCorruption as corrupt:
+                recompute_from_lineage(corrupt)
+                raise
+            except KeyError as gone:
+                # a peer reader already invalidated this
+                # attempt (its corruption recovery freed the
+                # buffers while we iterated the old id list):
+                # surface a TYPED recoverable fault so task
+                # retry / the ladder re-execute from lineage
+                # instead of dying on a bare KeyError
+                from ..fault.errors import TpuStageCrash
+
+                raise TpuStageCrash(
+                    "shuffle map output invalidated by a "
+                    "peer's corruption recovery — re-reading "
+                    "from the re-executed write",
+                    site="exchange.read") from gone
+
+        def make(p, segments=None):
+            """Reader for partition ``p``.  With ``segments`` (AQE skew
+            split, device path only) only the given contiguous
+            ``(item_idx, row_lo, row_hi)`` chunks of the partition are
+            sliced out — in order, so concatenating every slice of a
+            split reproduces the partition's exact row sequence."""
             def it():
                 import jax
                 import jax.numpy as jnp
+
+                if segments is not None:
+                    assert device_path, "segment reads are device-path"
+                    items_now = materialized()
+                    for item_idx, row_lo, row_hi in segments:
+                        buf_id, counts, starts = items_now[item_idx]
+                        n = int(row_hi) - int(row_lo)
+                        if n <= 0:
+                            continue
+                        F.maybe_inject_fault("exchange.read")
+                        b = acquire_block(buf_id)
+                        try:
+                            out = self._packed_slice_kernel(
+                                b,
+                                jnp.int32(int(starts[p]) + int(row_lo)),
+                                jnp.int32(n), metrics=self.metrics)
+                        finally:
+                            fw.release_batch(buf_id)
+                        self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                        yield DeviceBatch(out.schema, out.columns, n)
+                    return
 
                 # chunked streaming: one count sync per K slices (vs a
                 # device RTT per (partition, batch) pair) WITHOUT
@@ -577,29 +654,7 @@ class TpuShuffleExchangeExec(TpuExec):
                         n = int(counts[p])
                         if n == 0:
                             continue
-                    # promotion of a spilled map-output batch is an
-                    # allocation: route it through the retry framework
-                    try:
-                        b = R.retry_call(
-                            lambda bid=buf_id: fw.acquire_batch(bid),
-                            rctx)
-                    except TpuPayloadCorruption as corrupt:
-                        recompute_from_lineage(corrupt)
-                        raise
-                    except KeyError as gone:
-                        # a peer reader already invalidated this
-                        # attempt (its corruption recovery freed the
-                        # buffers while we iterated the old id list):
-                        # surface a TYPED recoverable fault so task
-                        # retry / the ladder re-execute from lineage
-                        # instead of dying on a bare KeyError
-                        from ..fault.errors import TpuStageCrash
-
-                        raise TpuStageCrash(
-                            "shuffle map output invalidated by a "
-                            "peer's corruption recovery — re-reading "
-                            "from the re-executed write",
-                            site="exchange.read") from gone
+                    b = acquire_block(buf_id)
                     if device_path:
                         # slice the contiguous row range out of the
                         # packed block; count is a HOST int already, so
@@ -628,6 +683,15 @@ class TpuShuffleExchangeExec(TpuExec):
             return it
 
         result = DevicePartitionedData([make(i) for i in range(self.n_out)])
+        # AQE handles: the adaptive executor materializes this exchange
+        # eagerly (aqe_materialize == the writer election) and builds
+        # re-grouped readers over the SAME resident buffers via
+        # aqe_read(p, segments) — see adaptive/executor.py
+        result.aqe_materialize = materialized
+        result.aqe_read = make
+        result.aqe_exchange_id = exchange_id
+        result.aqe_device_path = device_path
+        result.aqe_exchange = self
         # free the shuffle buffers when the read side is dropped — the
         # backstop behind the query-end per-shuffle cleanup in
         # Session.execute (reference: ShuffleBufferCatalog cleanup;

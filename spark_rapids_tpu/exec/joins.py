@@ -376,6 +376,21 @@ class TpuShuffledHashJoinExec(TpuHashJoinExec):
         return f"TpuShuffledHashJoin[{self.how}]"
 
 
+def _is_adaptive_build(node) -> bool:
+    """True when the broadcast build subtree contains a materialized
+    stage leaf — i.e. the join was converted by adaptive execution and
+    its broadcast artifact is scoped to this one query."""
+    from ..adaptive.executor import MaterializedStageExec
+
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, MaterializedStageExec):
+            return True
+        stack.extend(n.children)
+    return False
+
+
 class TpuBroadcastHashJoinExec(TpuHashJoinExec):
     """Build (right) side gathered across partitions once and joined
     against every stream partition (reference:
@@ -406,6 +421,16 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
         assert reg is not None, \
             "broadcast join requires the device session's registry"
         key = canonical_key(self.children[1])
+        if ctx is not None and _is_adaptive_build(self.children[1]):
+            # dynamic (AQE-converted) build side: the artifact's key
+            # weakly references a per-execution stage leaf, so no
+            # future query can ever hit it.  Record a strong ref so
+            # the session frees the build at query end — otherwise it
+            # stays cataloged until the registry's next lazy purge.
+            nodes = getattr(ctx, "aqe_broadcast_nodes", None)
+            if nodes is None:
+                nodes = ctx.aqe_broadcast_nodes = []
+            nodes.append(self.children[1])
 
         def build_batch() -> DeviceBatch:
             # the build child executes ONLY when the artifact is not

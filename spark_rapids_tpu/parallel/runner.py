@@ -1038,6 +1038,10 @@ class DistributedRunner:
         exchanges (P tiles) and join capacities; rows are front-packed,
         so trimming to the max shard count's bucket is lossless."""
         nrows = np.asarray(stacked.num_rows)
+        # stage-boundary statistics ride this EXISTING readback — the
+        # per-shard row counts are the distributed stage's partition
+        # histogram (adaptive/stats.py); no extra device sync
+        self._last_stage_rows = nrows
         need = bucket_rows(int(nrows.max()) if nrows.size else 1,
                            self.min_bucket)
         if need >= stacked.padded_rows:
@@ -1088,7 +1092,38 @@ class DistributedRunner:
                         stage, env_stacked, caps),
                     ctx, f"stage[{stage.sid}]")
             env_stacked[f"stage{stage.sid}"] = out
+            self._record_stage_stats(ctx, stage.sid)
         return self._collect_output(out, stages)
+
+    def _record_stage_stats(self, ctx, sid: int) -> None:
+        """Record the stage's per-shard row histogram from _retile's
+        already-host-resident count vector.  The SPMD program is
+        compiled as a whole, so no plan rewrite applies here — but the
+        histogram feeds profiles/metrics, a re-executed stage
+        re-records fresh numbers, and the scheduler reservation can
+        re-base off observed output."""
+        nrows = getattr(self, "_last_stage_rows", None)
+        self._last_stage_rows = None
+        stats = getattr(ctx, "stage_stats", None)
+        if nrows is None or stats is None \
+                or not getattr(nrows, "size", 0):
+            return
+        eid = stats.allocate_id()
+        obs = stats.record_exchange(
+            eid, items=[(None, nrows, None)], n_out=int(nrows.size),
+            device_path=True, total_bytes=0,
+            partitioning="MeshStage", name=f"stage[{sid}]")
+        fields = {"exchange": eid, "stage": sid,
+                  "partitions": obs.n_out, "rows": obs.total_rows,
+                  "device_path": True}
+        h = obs.histogram()
+        if h is not None:
+            fields.update(rows_min=h["min"], rows_p50=h["p50"],
+                          rows_max=h["max"], skew_pct=h["skewPct"])
+        emit_event("aqe_stage_stats", **fields)
+        from ..adaptive.executor import _rebase_reservation
+
+        _rebase_reservation(ctx)
 
     def _collect_output(self, out: DeviceBatch, stages) -> HostBatch:
         """Download the final stacked stage output to one HostBatch

@@ -68,6 +68,14 @@ class ExecContext:
         #: shuffle ids registered during this query, freed at query end
         #: (reference: per-shuffle cleanup, ShuffleBufferCatalog.scala)
         self.shuffle_ids: List[int] = []
+        #: runtime stage statistics (adaptive/stats.py): every exchange
+        #: write drain records its per-partition histogram here from
+        #: numbers its gated readback already pulled to the host —
+        #: collected unconditionally (histograms surface in profiles /
+        #: Prometheus even with adaptive.enabled=false)
+        from ..adaptive.stats import StageStats
+
+        self.stage_stats = StageStats()
         #: per-query telemetry (telemetry.enabled) — bound to the
         #: creating thread; worker spawn sites capture() the binding.
         #: None when disabled (begin() also clears any stale binding)

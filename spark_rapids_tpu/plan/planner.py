@@ -194,9 +194,38 @@ class Planner:
                 return sum(os.path.getsize(p) for p in node.paths)
             except OSError:
                 return None
-        if isinstance(node, (L.Project, L.Filter)):
+        if isinstance(node, L.Project):
+            # column pruning: a projection narrows what a broadcast
+            # would actually materialize — charging the child's FULL
+            # size (all file columns) overshoots and flips borderline
+            # joins to shuffle.  Scale by the projected/child row-width
+            # fraction (exact for fixed-width columns, nominal for
+            # strings).
+            est = Planner._estimate_bytes(node.children[0])
+            if est is None:
+                return None
+            child_w = Planner._schema_row_width(node.children[0].schema)
+            proj_w = Planner._schema_row_width(node.schema)
+            return int(est * proj_w / child_w)
+        if isinstance(node, L.Filter):
             return Planner._estimate_bytes(node.children[0])
         if isinstance(node, L.Limit):
             est = Planner._estimate_bytes(node.children[0])
             return est
         return None
+
+    @staticmethod
+    def _schema_row_width(schema) -> int:
+        """Nominal bytes per row of a schema: exact itemsize for
+        fixed-width columns, 16B nominal for strings (matches the
+        file-size heuristic's variable-length reality well enough for
+        a pruning ratio)."""
+        from .. import types as T
+
+        width = 0
+        for f in schema:
+            if f.dtype.id is T.TypeId.STRING:
+                width += 16
+            else:
+                width += int(getattr(f.dtype.np_dtype, "itemsize", 8))
+        return max(width, 1)

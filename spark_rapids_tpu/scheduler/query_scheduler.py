@@ -188,6 +188,11 @@ class QueryScheduler:
         self._next_qid = itertools.count(1)
         self._n_active = 0
         self._running: set = set()  # running QueryHandles
+        #: worker-thread ident -> [currently held reservation bytes];
+        #: the mutable cell lets AQE shrink a running query's charge
+        #: (rebase_reservation) while the worker's finally still
+        #: releases exactly what remains held
+        self._reservations: Dict[int, List[int]] = {}
         self._workers: set = set()  # live worker threads
         self._shutdown = False
         _LIVE.add(self)
@@ -345,6 +350,9 @@ class QueryScheduler:
             token.deadline = (time.monotonic()
                               + self.query_timeout_ms / 1000.0)
         _cancel.activate(token)
+        holder = [reservation]
+        with self._cv:
+            self._reservations[threading.get_ident()] = holder
         sink: Dict = {}
         try:
             try:
@@ -380,13 +388,40 @@ class QueryScheduler:
                 # the semaphore can never get a dead thread's permit
                 # back, so the worker's last act is to drop its own
                 self._dm.semaphore.release_task()
-            if reservation and self._dm is not None:
-                self._dm.release_reservation(reservation)
+            with self._cv:
+                held = holder[0]
+                holder[0] = 0
+                self._reservations.pop(threading.get_ident(), None)
+            if held and self._dm is not None:
+                self._dm.release_reservation(held)
             with self._cv:
                 self._n_active -= 1
                 self._running.discard(handle)
                 self._workers.discard(threading.current_thread())
                 self._cv.notify_all()
+
+    # ----- adaptive reservation rebase --------------------------------------
+    def rebase_reservation(self, observed_bytes: int) -> int:
+        """SHRINK the calling worker thread's HBM reservation to
+        ``observed_bytes`` (never grows — growing mid-flight could
+        over-commit the arena) and wake the dispatcher so a queued
+        query can use the freed headroom.  Called by the adaptive
+        executor once real stage-output sizes replace the admission
+        estimate.  Returns the bytes freed (0 when not a worker
+        thread, or nothing to free)."""
+        if self._dm is None:
+            return 0
+        target = max(0, int(observed_bytes))
+        with self._cv:
+            holder = self._reservations.get(threading.get_ident())
+            if holder is None or holder[0] <= target:
+                return 0
+            freed = holder[0] - target
+            holder[0] = target
+        self._dm.release_reservation(freed)
+        with self._cv:
+            self._cv.notify_all()
+        return freed
 
     def _attribute(self, handle: QueryHandle, sink: Dict) -> None:
         """Per-query metric/profile attribution from the attempt's own

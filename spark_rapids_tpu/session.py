@@ -275,6 +275,12 @@ class Session:
         from .memory.retry import retry_summary
 
         merged = ctx.metrics.snapshot()
+        # per-exchange partition histograms (adaptive/stats.py) —
+        # surfaced regardless of adaptive.enabled, so shuffle skew is
+        # visible in last_metrics / profiles / the Prometheus export
+        stage_stats = getattr(ctx, "stage_stats", None)
+        if stage_stats is not None:
+            merged.update(stage_stats.metrics())
         if preserve:
             merged.update(preserve)
         if self.device_manager is not None:
@@ -309,7 +315,29 @@ class Session:
         # session.last_metrics/last_profile are last-writer-wins shared
         # state, so the handle reads these instead
         ctx.final_metrics = merged
-        ctx.profile = finish_query(self, ctx, phys=phys, metrics=merged)
+        # an adaptive run profiles its FINAL (rewritten) plan — the
+        # "AdaptiveSparkPlan isFinalPlan=true" tree — not the static one
+        final_phys = getattr(ctx, "aqe_final_phys", None) or phys
+        ctx.profile = finish_query(self, ctx, phys=final_phys,
+                                   metrics=merged)
+        nodes = getattr(ctx, "aqe_broadcast_nodes", None)
+        if nodes:
+            # dynamic-conversion build batches are keyed by weakrefs
+            # to THIS execution's stage leaves: no future query can
+            # reuse them, so free them now (the recorded strong refs
+            # keep the keys matchable) instead of leaving them
+            # cataloged until the registry's next lazy purge
+            if self.broadcast_registry is not None:
+                from .exec.broadcast import canonical_key
+
+                for node in nodes:
+                    self.broadcast_registry.free_key(canonical_key(node))
+            ctx.aqe_broadcast_nodes = None
+        if getattr(ctx, "aqe_final_phys", None) is not None:
+            # the final plan holds the per-execution stage leaves (and
+            # through them the resident shuffle blocks) — drop it now
+            # that the profile is rendered
+            ctx.aqe_final_phys = None
 
     def _execute_native(self, plan: L.LogicalPlan, *,
                         scheduled: bool = False, cancel_token=None,
@@ -322,7 +350,15 @@ class Session:
             ctx_sink["phys"] = phys
             ctx_sink["ctx"] = ctx
         try:
-            data = phys.execute(ctx)
+            from .adaptive.executor import maybe_execute_adaptive
+
+            # adaptive execution: materialize stages one at a time and
+            # re-plan the unexecuted suffix from real sizes; returns
+            # None when the plan/conf is ineligible (then the static
+            # plan executes unchanged)
+            data = maybe_execute_adaptive(phys, ctx)
+            if data is None:
+                data = phys.execute(ctx)
             schema = phys.schema if len(phys.schema) else plan.schema
             return collect_batches(data, schema, ctx)
         finally:

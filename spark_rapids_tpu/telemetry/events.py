@@ -15,7 +15,16 @@ noteworthy engine transition emits one flat JSON record:
                        query scheduler shed a submit/queued query,
 ``query_cancelled``  — a scheduled query terminated by cooperative
                        cancellation (explicit, deadline, or injected),
-``fault_injected``   — the deterministic injector fired (test mode).
+``fault_injected``   — the deterministic injector fired (test mode),
+``aqe_stage_stats``  — a shuffle stage materialized; its partition
+                       histogram (adaptive/stats.py),
+``aqe_broadcast_join`` — AQE demoted a shuffled-hash join to broadcast
+                       from the observed build-side bytes,
+``aqe_skew_split``   — AQE split a skewed partition into sub-slices,
+``aqe_coalesce_partitions`` — AQE merged adjacent small partitions,
+``aqe_reservation_rebase`` — the scheduler's HBM reservation shrank to
+                       observed stage output,
+``aqe_final_plan``   — adaptive execution finished; the final plan.
 
 Emission contract: call sites OUTSIDE ``telemetry/`` must only use
 :func:`emit_event`, which is exception-safe (never raises, never

@@ -140,6 +140,13 @@ class QueryProfile:
         if self.plan_text is not None:
             lines.append("")
             lines.append("-- Physical plan (annotated) --")
+            if any(k.startswith("aqe.") for k in self.metrics):
+                # the rendered tree IS the final re-optimized plan (the
+                # session profiles ctx.aqe_final_phys) — mark it the
+                # way Spark's UI marks an AdaptiveSparkPlanExec
+                lines.append(
+                    "AdaptiveSparkPlan isFinalPlan=true (stages="
+                    f"{self.metrics.get('aqe.numStages', 0)})")
             lines.append(self.plan_text)
         hot = hot_operators(self.metrics, top_n)
         if hot:
@@ -161,6 +168,44 @@ class QueryProfile:
                 v = kc[k]
                 lines.append(f"  {k}: "
                              + (_fmt_ms(v) if k.endswith("Ns") else str(v)))
+        aqe = {k.split(".", 1)[1]: v for k, v in self.metrics.items()
+               if k.startswith("aqe.")}
+        if aqe:
+            # aqe. is a counter family (lowercase prefix) like
+            # kernelCache. — render its decisions explicitly
+            lines.append("")
+            lines.append("-- Adaptive execution --")
+            for k in sorted(aqe):
+                lines.append(f"  {k}: {aqe[k]}")
+        ex: Dict[str, Dict[str, int]] = {}
+        for k, v in self.metrics.items():
+            if k.startswith("shuffle.exchange") and k.count(".") >= 2:
+                head, metric = k.rsplit(".", 1)
+                ex.setdefault(head, {})[metric] = v
+        if ex:
+            # per-exchange partition row histograms (StageStats) —
+            # present whether or not adaptive execution ran
+            lines.append("")
+            lines.append("-- Exchange partition histograms --")
+
+            def _eid(head: str) -> int:
+                try:
+                    return int(head[len("shuffle.exchange"):])
+                except ValueError:
+                    return 0
+
+            for head in sorted(ex, key=_eid):
+                m = ex[head]
+                parts = [f"partitions={m.get('partitions', 0)}",
+                         f"rows={m.get('rowsTotal', 0)}",
+                         f"bytes={m.get('bytesTotal', 0)}"]
+                if "partRowsP50" in m:
+                    parts.append(
+                        f"rows/part min={m.get('partRowsMin', 0)} "
+                        f"p50={m.get('partRowsP50', 0)} "
+                        f"max={m.get('partRowsMax', 0)} "
+                        f"skew={m.get('skewPct', 0)}%")
+                lines.append(f"  {head}: " + " ".join(parts))
         lines.append("")
         lines.append("-- Span tree --")
         self._render_span(self.span_tree(), 0, lines)

@@ -1,0 +1,373 @@
+"""Adaptive query execution (spark_rapids_tpu/adaptive/).
+
+The contracts under test:
+
+* **Bit-identity** — every AQE rewrite (partition coalescing, skew
+  splitting, dynamic broadcast conversion) produces results identical
+  to the non-adaptive plan: same values, same row placement after the
+  engine's re-partitioning rules.  Pinned on TPC-H q1/q3/q5/q6/q16 and
+  on synthetic trigger cases, including under deterministic
+  corrupt/OOM injection and concurrent ``Session.submit``.
+* **Trigger boundaries** — each rewrite fires exactly when its conf
+  says so (``adaptive.targetPartitionBytes``,
+  ``adaptive.skewedPartitionFactor`` + ``thresholdBytes``,
+  ``adaptive.autoBroadcastJoinThreshold``), observable through the
+  structured ``aqe_*`` events and ``aqe.*`` metrics.
+* **Fresh stats on retry** — a re-executed stage re-records its drain
+  statistics; the planner never re-plans from stale numbers.
+* **Histograms always on** — per-exchange partition row histograms
+  surface in ``last_metrics`` / ``profile_report()`` / the Prometheus
+  export even with ``adaptive.enabled=false``.
+"""
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.adaptive.stats import (StageStats, coalesce_groups,
+                                             split_partition_segments)
+from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.testing.asserts import assert_rows_equal
+
+SF = 0.0007
+SEED = 7
+
+TELE = {"spark.rapids.tpu.telemetry.enabled": True}
+#: force static shuffled joins (the tiny test data broadcasts under the
+#: default 10MB static threshold, which would leave the dynamic
+#: conversion nothing to do); the ADAPTIVE threshold stays default
+SHUFFLED = {"spark.rapids.tpu.sql.broadcastSizeThreshold": 0}
+FAST = {
+    "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+    "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+}
+
+
+def _sess(*confs, adaptive=True):
+    conf = {"spark.rapids.tpu.sql.adaptive.enabled": adaptive}
+    for c in confs:
+        conf.update(c)
+    return srt.Session(conf)
+
+
+def _events(sess):
+    prof = sess.last_profile
+    return [e["event"] for e in prof.events.snapshot()] if prof else []
+
+
+def _norm(rows):
+    return sorted(
+        (tuple((None if v is None else
+                (round(v, 6) if isinstance(v, float) else v))
+               for v in r) for r in rows),
+        key=repr)
+
+
+def _join_agg_df(sess, n=300, keys=40):
+    rng = np.random.RandomState(3)
+    orders = {"o_custkey": rng.randint(0, keys, n).tolist(),
+              "o_total": [round(float(v), 6)
+                          for v in rng.rand(n) * 1000]}
+    cust = {"c_custkey": list(range(keys)),
+            "c_nation": rng.randint(0, 5, keys).tolist()}
+    o = sess.create_dataframe(orders)
+    c = sess.create_dataframe(cust)
+    j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+    return j.group_by("c_nation").agg(
+        F.sum("o_total").alias("rev"), F.count("o_total").alias("n"))
+
+
+def _skewed_join_df(sess):
+    """~1500 rows of key 0 against a uniform tail: one hash partition
+    dwarfs the median."""
+    rng = np.random.RandomState(11)
+    keys = [0] * 1500 + rng.randint(1, 40, 120).tolist()
+    left = {"k": keys,
+            "v": [round(float(v), 6) for v in rng.rand(len(keys))]}
+    right = {"k": list(range(40)),
+             "tag": rng.randint(0, 7, 40).tolist()}
+    lf = sess.create_dataframe(left, n_partitions=8)
+    rf = sess.create_dataframe(right, n_partitions=8)
+    return lf.join(rf, on=(["k"], ["k"]), how="inner")
+
+
+# ==========================================================================
+# Pure helpers (adaptive/stats.py)
+# ==========================================================================
+def test_coalesce_groups_boundaries():
+    # adjacent merging up to target, never reordering
+    assert coalesce_groups([10, 10, 10, 10], 20) == [(0, 1), (2, 3)]
+    # an over-target partition stays alone; neighbors still merge
+    assert coalesce_groups([5, 100, 5, 5], 20) == [(0,), (1,), (2, 3)]
+    # everything fits into one
+    assert coalesce_groups([1, 1, 1], 100) == [(0, 1, 2)]
+    # target smaller than every partition: identity grouping
+    assert coalesce_groups([10, 10], 1) == [(0,), (1,)]
+    assert coalesce_groups([], 10) == []
+
+
+def test_split_partition_segments_reproduces_row_sequence():
+    rng = np.random.RandomState(5)
+    item_counts = [rng.randint(0, 9, 4).astype(np.int64)
+                   for _ in range(6)]
+    p = 2
+    rows = [(i, r) for i, c in enumerate(item_counts)
+            for r in range(int(c[p]))]
+    for k in (1, 2, 3, 5, 50):
+        slices = split_partition_segments(item_counts, p, k)
+        got = [(i, r) for segs in slices
+               for (i, lo, hi) in segs for r in range(lo, hi)]
+        assert got == rows, f"k={k} broke the row sequence"
+        for segs in slices:
+            assert all(hi > lo for (_, lo, hi) in segs)
+    # empty partition: no slices
+    empty = [np.zeros(4, dtype=np.int64)]
+    assert split_partition_segments(empty, 1, 3) == []
+
+
+def test_stage_stats_overwrite_on_retry_and_metrics():
+    st = StageStats()
+    eid = st.allocate_id()
+    st.record_exchange(eid, items=[(1, np.array([7, 1]), None)],
+                       n_out=2, device_path=True, total_bytes=100,
+                       partitioning="HashPartitioning")
+    # a retried drain re-records: FRESH numbers replace the stale ones
+    st.record_exchange(eid, items=[(2, np.array([3, 5]), None)],
+                       n_out=2, device_path=True, total_bytes=64,
+                       partitioning="HashPartitioning")
+    obs = st.get(eid)
+    assert obs.total_rows == 8 and obs.total_bytes == 64
+    assert [obs.rows_for(p) for p in (0, 1)] == [3, 5]
+    m = st.metrics()
+    assert m[f"shuffle.exchange{eid}.partRowsMax"] == 5
+    assert m[f"shuffle.exchange{eid}.rowsTotal"] == 8
+    assert st.observed_peak_bytes() == 64
+
+
+# ==========================================================================
+# Rewrite trigger / no-trigger boundaries
+# ==========================================================================
+def test_broadcast_conversion_trigger_and_equality():
+    off = _join_agg_df(_sess(SHUFFLED, adaptive=False)).collect()
+    sess = _sess(SHUFFLED, TELE)
+    got = _join_agg_df(sess).collect()
+    assert _norm(got) == _norm(off)
+    m = sess.last_metrics
+    assert m.get("aqe.numJoinsConverted", 0) >= 1, sorted(m)[:10]
+    assert "aqe_broadcast_join" in _events(sess)
+
+
+def test_broadcast_conversion_no_trigger_when_threshold_zero():
+    conf = {"spark.rapids.tpu.sql.adaptive.autoBroadcastJoinThreshold": 0}
+    off = _join_agg_df(_sess(SHUFFLED, adaptive=False)).collect()
+    sess = _sess(SHUFFLED, TELE, conf)
+    got = _join_agg_df(sess).collect()
+    assert _norm(got) == _norm(off)
+    assert "aqe.numJoinsConverted" not in sess.last_metrics
+    assert "aqe_broadcast_join" not in _events(sess)
+
+
+def test_coalesce_trigger_and_no_trigger_boundary():
+    # default 64MB target: the tiny partitions all merge
+    sess = _sess(TELE)
+    got = _join_agg_df(sess).collect()
+    assert sess.last_metrics.get("aqe.numPartitionsCoalesced", 0) >= 1
+    assert "aqe_coalesce_partitions" in _events(sess)
+    # 1-byte target: nothing fits together — identity grouping
+    tiny = {"spark.rapids.tpu.sql.adaptive.targetPartitionBytes": 1}
+    sess2 = _sess(TELE, tiny)
+    got2 = _join_agg_df(sess2).collect()
+    assert "aqe.numPartitionsCoalesced" not in sess2.last_metrics
+    assert _norm(got) == _norm(got2)
+
+
+#: skew rewrite confs: conversion disabled (it outranks skew on these
+#: tiny build sides), aggressive factor/threshold so the synthetic
+#: skew qualifies
+SKEW = {"spark.rapids.tpu.sql.adaptive.autoBroadcastJoinThreshold": 0,
+        "spark.rapids.tpu.sql.adaptive.skewedPartitionFactor": 1.5,
+        "spark.rapids.tpu.sql.adaptive.skewedPartitionThresholdBytes": 1,
+        "spark.rapids.tpu.sql.adaptive.maxSkewSlices": 4}
+
+
+def test_skew_split_trigger_and_equality():
+    off = _skewed_join_df(_sess(SHUFFLED, adaptive=False)).collect()
+    sess = _sess(SHUFFLED, TELE, SKEW)
+    got = _skewed_join_df(sess).collect()
+    assert _norm(got) == _norm(off)
+    m = sess.last_metrics
+    assert m.get("aqe.numSkewSplits", 0) >= 1, \
+        sorted(k for k in m if k.startswith(("aqe.", "shuffle.ex")))
+    assert "aqe_skew_split" in _events(sess)
+
+
+def test_skew_split_no_trigger_at_default_factor():
+    # uniform keys never exceed 4x the median
+    sess = _sess(SHUFFLED, TELE, {
+        "spark.rapids.tpu.sql.adaptive.autoBroadcastJoinThreshold": 0})
+    off = _join_agg_df(_sess(SHUFFLED, adaptive=False)).collect()
+    got = _join_agg_df(sess).collect()
+    assert _norm(got) == _norm(off)
+    assert "aqe.numSkewSplits" not in sess.last_metrics
+    assert "aqe_skew_split" not in _events(sess)
+
+
+# ==========================================================================
+# TPC-H bit-identity, adaptive on vs off
+# ==========================================================================
+_UNORDERED = {5, 6, 16}
+
+
+def _run_tpch(qnum, *confs, adaptive):
+    sess = _sess(*confs, adaptive=adaptive)
+    tables = tpch_datagen.dataframes(sess, sf=SF, seed=SEED)
+    return tpch.QUERIES[qnum](tables).collect(), sess
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 5, 6, 16])
+def test_tpch_adaptive_bit_identity(qnum):
+    off, _ = _run_tpch(qnum, SHUFFLED, adaptive=False)
+    on, sess = _run_tpch(qnum, SHUFFLED, adaptive=True)
+    assert_rows_equal(off, on, ignore_order=qnum in _UNORDERED,
+                      approximate_float=1e-6)
+    assert sess.last_metrics.get("aqe.numStages", 0) >= 1
+
+
+def test_tpch_q3_conversion_and_q1_coalesce_events():
+    """The acceptance demos: a real TPC-H query converting a join and
+    one coalescing partitions, asserted via structured events."""
+    _, s3 = _run_tpch(3, SHUFFLED, TELE, adaptive=True)
+    assert s3.last_metrics.get("aqe.numJoinsConverted", 0) >= 1
+    assert "aqe_broadcast_join" in _events(s3)
+    _, s1 = _run_tpch(1, TELE, adaptive=True)
+    assert s1.last_metrics.get("aqe.numPartitionsCoalesced", 0) >= 1
+    assert "aqe_coalesce_partitions" in _events(s1)
+    # the profile renders the FINAL plan, AdaptiveSparkPlan-style
+    report = s1.profile_report()
+    assert "AdaptiveSparkPlan isFinalPlan=true" in report
+    assert "-- Adaptive execution --" in report
+
+
+def _inject(fault_type, site, skip=0):
+    return {**FAST,
+            "spark.rapids.tpu.fault.injection.mode": "nth",
+            "spark.rapids.tpu.fault.injection.type": fault_type,
+            "spark.rapids.tpu.fault.injection.site": site,
+            "spark.rapids.tpu.fault.injection.skipCount": skip,
+            "spark.rapids.tpu.sql.taskRetries": 3}
+
+
+@pytest.mark.fault_injection
+def test_tpch_q3_adaptive_under_corrupt_injection():
+    """A corrupted exchange write re-executes the stage lineage; the
+    adaptive driver re-plans from the FRESH drain's stats and the
+    result stays bit-identical."""
+    off, _ = _run_tpch(3, SHUFFLED, adaptive=False)
+    on, sess = _run_tpch(3, SHUFFLED, TELE,
+                         _inject("corrupt", "exchange.write"),
+                         adaptive=True)
+    assert_rows_equal(off, on, ignore_order=False,
+                      approximate_float=1e-6)
+    assert sess.last_metrics.get("aqe.numStages", 0) >= 1
+
+
+@pytest.mark.oom_injection
+def test_tpch_q3_adaptive_under_oom_injection():
+    oom = {**FAST,
+           "spark.rapids.tpu.memory.oomInjection.mode": "nth",
+           "spark.rapids.tpu.memory.oomInjection.skipCount": 2}
+    off, _ = _run_tpch(3, SHUFFLED, adaptive=False)
+    on, sess = _run_tpch(3, SHUFFLED, oom, adaptive=True)
+    assert_rows_equal(off, on, ignore_order=False,
+                      approximate_float=1e-6)
+    assert sess.last_metrics.get("aqe.numStages", 0) >= 1
+
+
+# ==========================================================================
+# Concurrent submission
+# ==========================================================================
+def test_adaptive_under_concurrent_submit():
+    sess = _sess(SHUFFLED, TELE)
+    serial = _join_agg_df(_sess(SHUFFLED, adaptive=False)).collect()
+    handles = [sess.submit(_join_agg_df(sess)) for _ in range(3)]
+    for h in handles:
+        got = h.result(timeout=180).to_rows()
+        assert _norm(got) == _norm(serial)
+        assert h.metrics.get("aqe.numStages", 0) >= 1, \
+            sorted(h.metrics)[:10]
+    sess.shutdown_scheduler()
+
+
+def test_adaptive_rebases_scheduler_reservation():
+    sess = _sess(SHUFFLED, TELE, {
+        "spark.rapids.tpu.scheduler.reservationFraction": 0.5})
+    h = sess.submit(_join_agg_df(sess))
+    h.result(timeout=180)
+    freed = h.metrics.get("aqe.reservationFreedBytes", 0)
+    assert freed > 0, sorted(k for k in h.metrics
+                             if k.startswith("aqe."))
+    assert any(e["event"] == "aqe_reservation_rebase"
+               for e in h.events())
+    sess.shutdown_scheduler()
+
+
+# ==========================================================================
+# Histograms surface with adaptive OFF
+# ==========================================================================
+def test_partition_histograms_surface_with_adaptive_off():
+    from spark_rapids_tpu.telemetry.export import prometheus_text
+
+    sess = _sess(SHUFFLED, TELE, adaptive=False)
+    _join_agg_df(sess).collect()
+    m = sess.last_metrics
+    hist = [k for k in m if k.startswith("shuffle.exchange")]
+    assert any(k.endswith("partRowsP50") for k in hist), sorted(m)[:12]
+    assert not any(k.startswith("aqe.") for k in m)
+    report = sess.profile_report()
+    assert "-- Exchange partition histograms --" in report
+    assert "AdaptiveSparkPlan" not in report
+    text = prometheus_text(m)
+    assert "shuffle" in text and "partRowsP50" in text
+
+
+# ==========================================================================
+# Satellite: static broadcast estimate respects column pruning
+# ==========================================================================
+def _find_joins(node, out):
+    from spark_rapids_tpu.plan import physical as P
+
+    if isinstance(node, P.HashJoinExec):
+        out.append(node)
+    for c in node.children:
+        _find_joins(c, out)
+
+
+def test_static_broadcast_estimate_scales_with_projection():
+    from spark_rapids_tpu.plan.optimizer import optimize
+    from spark_rapids_tpu.plan.planner import Planner
+
+    n = 512
+    wide = {f"c{i}": list(range(n)) for i in range(10)}  # 10 int64 cols
+    left = {"k": list(range(64))}
+
+    def plan_for(threshold, project):
+        sess = srt.Session(
+            {"spark.rapids.tpu.sql.broadcastSizeThreshold": threshold})
+        lf = sess.create_dataframe(left)
+        rf = sess.create_dataframe(wide)
+        if project:
+            rf = rf.select("c0")
+        j = lf.join(rf, on=(["k"], ["c0"]), how="inner")
+        joins = []
+        _find_joins(Planner(sess.conf).plan(optimize(j.plan)), joins)
+        assert len(joins) == 1
+        return joins[0]
+
+    # a threshold between the PRUNED build size (~1 of 10 int64
+    # columns) and the full relation: only the projection-scaled
+    # estimate lets the join broadcast
+    threshold = 2 * 8 * n
+    assert plan_for(threshold, project=True).broadcast, \
+        "projected build side should broadcast under the scaled estimate"
+    assert not plan_for(threshold, project=False).broadcast, \
+        "unprojected wide build side must still exceed the threshold"

@@ -292,7 +292,11 @@ def test_coalesce_cuts_build_dispatches():
     the kernel dispatches of the exchange write (one build per merged
     batch instead of one per scan batch) — measured through the
     kernel-cache telemetry, not timing."""
-    small = {"spark.rapids.tpu.sql.reader.batchSizeRows": 32}
+    # static shuffled plan on purpose: AQE's dynamic broadcast
+    # conversion would bypass the exchange write whose dispatch
+    # economics this measures
+    small = {"spark.rapids.tpu.sql.reader.batchSizeRows": 32,
+             "spark.rapids.tpu.sql.adaptive.enabled": False}
 
     sess_off = srt.Session(_mode_conf(
         "device", **dict(small, **{

@@ -1,0 +1,215 @@
+"""AST lint: adaptive query execution stays off the device.
+
+Two contracts, enforced at the source level so a refactor cannot
+silently regress them:
+
+* **Zero added device syncs.**  AQE feeds exclusively on statistics the
+  shuffle write path ALREADY pulled to host (the gated count fetch in
+  ``exec/exchange.py``): nothing under ``spark_rapids_tpu/adaptive/``
+  may import jax or call a host-sync primitive, and the exchange
+  function that records stats must stay free of ungated syncs of its
+  own.
+* **Every rewrite announces itself.**  Each decision site in
+  ``adaptive/planner.py`` (anything bumping an ``aqe.*`` metric) must
+  emit the matching structured ``aqe_*`` event — the events are the
+  acceptance surface for "which rewrite fired", so a silent rewrite is
+  a lint failure, not a style nit.
+"""
+import ast
+import os
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "spark_rapids_tpu")
+ADAPTIVE = os.path.join(PKG, "adaptive")
+
+#: functions in exchange.py whose host syncs are the DESIGNED, gated
+#: count fetches (mirrors tests/test_lint_shuffle.py) — stats recording
+#: rides these, it must not add its own
+GATED_FUNCS = {"fetch_counts", "flush", "drain_outs"}
+HOST_SYNC_NAMES = {"device_get", "tolist", "item", "device_to_host",
+                   "to_host"}
+
+
+def _parse(path):
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _adaptive_modules():
+    for fn in sorted(os.listdir(ADAPTIVE)):
+        if fn.endswith(".py"):
+            yield fn, _parse(os.path.join(ADAPTIVE, fn))
+
+
+def _terminal_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _calls_in(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _is_host_sync(call) -> bool:
+    name = _terminal_name(call.func)
+    if name in HOST_SYNC_NAMES:
+        return True
+    if (name == "asarray" and isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "np"):
+        return True
+    return False
+
+
+def _functions_with_calls(tree):
+    """Yield (funcdef, calls-in-OWN-body) — nested defs own their
+    bodies, so a gated inner function doesn't taint its parent."""
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        own = []
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # the nested def owns its body
+            if isinstance(n, ast.Call):
+                own.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        yield fn, own
+
+
+# ==========================================================================
+# Host-only statistics
+# ==========================================================================
+def test_adaptive_package_never_imports_jax():
+    offenders = []
+    for fn, tree in _adaptive_modules():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for name in names:
+                if name == "jax" or name.startswith("jax."):
+                    offenders.append(f"{fn}:{node.lineno} imports {name}")
+    assert not offenders, (
+        "adaptive/ must stay device-free (stats are host math over "
+        f"already-fetched counts): {offenders}")
+
+
+def test_adaptive_package_has_no_host_sync_calls():
+    offenders = []
+    checked = 0
+    for fn, tree in _adaptive_modules():
+        for call in _calls_in(tree):
+            checked += 1
+            name = _terminal_name(call.func)
+            if name in HOST_SYNC_NAMES:
+                offenders.append(f"{fn}:{call.lineno} calls {name}()")
+    assert checked >= 50, "lint saw suspiciously little code"
+    assert not offenders, (
+        f"host-sync primitives in adaptive/: {offenders}")
+
+
+def test_planner_and_executor_never_touch_device_arrays():
+    """np.asarray on the rewrite/driver hot path would be a device
+    readback in disguise (DevicePartitionedData flows through here);
+    only stats.py may coerce — its inputs are host-resident by the
+    record_exchange contract."""
+    offenders = []
+    for fn, tree in _adaptive_modules():
+        if fn not in ("planner.py", "executor.py"):
+            continue
+        for call in _calls_in(tree):
+            if _is_host_sync(call):
+                offenders.append(
+                    f"{fn}:{call.lineno} {_terminal_name(call.func)}()")
+    assert not offenders, offenders
+
+
+def test_exchange_stats_recording_adds_no_syncs():
+    """The function in exec/exchange.py that calls record_exchange must
+    not perform host syncs of its own — it records numbers the gated
+    fetch already pulled.  (The gated functions themselves are nested
+    defs and own their bodies.)"""
+    tree = _parse(os.path.join(PKG, "exec", "exchange.py"))
+    recorders = 0
+    offenders = []
+    for fn, own_calls in _functions_with_calls(tree):
+        names = {_terminal_name(c.func) for c in own_calls}
+        if "record_exchange" not in names:
+            continue
+        recorders += 1
+        for call in own_calls:
+            if _is_host_sync(call):
+                offenders.append(
+                    f"{fn.name}:{call.lineno} "
+                    f"{_terminal_name(call.func)}()")
+    assert recorders >= 1, \
+        "exchange.py no longer records stage stats — AQE is blind"
+    assert not offenders, (
+        "stats recording added device syncs to the shuffle write "
+        f"path: {offenders}")
+
+
+# ==========================================================================
+# Every rewrite emits its decision
+# ==========================================================================
+def _emitted_literals(own_calls):
+    out = set()
+    for call in own_calls:
+        if _terminal_name(call.func) == "emit_event" and call.args:
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.add(arg.value)
+    return out
+
+
+def test_every_rewrite_decision_site_emits_event():
+    tree = _parse(os.path.join(ADAPTIVE, "planner.py"))
+    decision_sites = 0
+    offenders = []
+    for fn, own_calls in _functions_with_calls(tree):
+        bumps = [c for c in own_calls
+                 if _terminal_name(c.func) == "_bump"]
+        if not bumps:
+            continue
+        decision_sites += 1
+        emitted = _emitted_literals(own_calls)
+        if not any(e.startswith("aqe_") for e in emitted):
+            offenders.append(
+                f"{fn.name} bumps an aqe.* metric but emits no "
+                "aqe_* event")
+    assert decision_sites >= 3, (
+        "expected at least broadcast/skew/coalesce decision sites, "
+        f"found {decision_sites}")
+    assert not offenders, offenders
+
+
+def test_all_three_rewrite_events_exist():
+    tree = _parse(os.path.join(ADAPTIVE, "planner.py"))
+    emitted = set()
+    for fn, own_calls in _functions_with_calls(tree):
+        emitted |= _emitted_literals(own_calls)
+    for required in ("aqe_broadcast_join", "aqe_skew_split",
+                     "aqe_coalesce_partitions"):
+        assert required in emitted, (
+            f"planner.py lost the {required} decision event "
+            f"(has {sorted(emitted)})")
+
+
+def test_executor_emits_stage_stats_and_final_plan():
+    tree = _parse(os.path.join(ADAPTIVE, "executor.py"))
+    emitted = set()
+    for fn, own_calls in _functions_with_calls(tree):
+        emitted |= _emitted_literals(own_calls)
+    assert "aqe_stage_stats" in emitted
+    assert "aqe_final_plan" in emitted

@@ -177,6 +177,10 @@ def test_grace_join_recurses_past_bucket_cap(monkeypatch):
         "spark.rapids.tpu.sql.reader.batchSizeRows": 8192,
         "spark.rapids.tpu.sql.bucketMinRows": 64,
         "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+        # keep the shuffled-hash plan: AQE would broadcast-convert this
+        # tiny build side and the grace recursion under test would
+        # never engage
+        "spark.rapids.tpu.sql.adaptive.enabled": False,
     }
 
     def build(sess):
