@@ -617,28 +617,15 @@ def _transfer_split(sess, wall_s):
 
 
 def _atomic_write_json(path, obj) -> None:
-    """Write a BENCH_* artifact atomically: serialize into a temp file
-    in the SAME directory, fsync, then ``os.replace`` over the target.
-    A crash/kill mid-write (the wedged-tunnel shape) leaves the
-    previous artifact intact instead of a truncated JSON — readers
-    always see either the old file or the complete new one."""
-    import tempfile
+    """Write a BENCH_* artifact atomically via the engine's shared
+    temp+fsync+rename helper (spark_rapids_tpu/utils/fsio.py — the same
+    discipline checkpoint manifests and spill frames use).  A
+    crash/kill mid-write (the wedged-tunnel shape) leaves the previous
+    artifact intact instead of a truncated JSON — readers always see
+    either the old file or the complete new one."""
+    from spark_rapids_tpu.utils import fsio
 
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".bench-", suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(obj, f, indent=1, sort_keys=True)
-            f.write("\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    fsio.atomic_write_json(path, obj)
 
 
 def _persist_tpu_artifact(summary, path=None) -> None:

@@ -277,6 +277,11 @@ def _materialize_stage(exch: TpuShuffleExchangeExec,
                     sem.release_task()  # don't hold permits asleep
                 if attempt == retries:
                     raise
+                # unified attempt budget (fault.maxTotalAttempts): a
+                # stage retry is one recovery attempt
+                from ..fault.budget import GLOBAL as _budget
+
+                _budget.charge("stage_retry", site="aqe.materialize")
                 delay = backoff_delay_s(attempt, backoff_base,
                                         backoff_max, backoff_rng)
                 log.warning(

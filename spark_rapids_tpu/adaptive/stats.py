@@ -145,6 +145,29 @@ class StageStats:
             self._obs[exchange_id] = obs
         return obs
 
+    def record_resumed(self, exchange_id: int, *,
+                       n_out: int, part_rows: Sequence[int],
+                       total_bytes: int, partitioning: str,
+                       name: str) -> ExchangeObservation:
+        """A checkpoint-RESUMED exchange (recovery/): per-partition rows
+        come exactly from the checkpoint manifest, not a drain.  There
+        are no live packed blocks, so ``device_path`` is False and
+        ``item_counts`` is None — the skew-split rewrite (which needs
+        segment reads over resident device blocks) correctly sees this
+        stage as unsplittable, while coalescing, broadcast conversion
+        and reservation re-basing get real sizes."""
+        rows = np.asarray([int(r) for r in part_rows], dtype=np.int64)
+        obs = ExchangeObservation(
+            exchange_id, n_out=n_out, device_path=False,
+            partitioning=partitioning, name=name,
+            total_bytes=int(total_bytes),
+            total_rows=int(rows.sum()) if rows.size else 0,
+            part_rows=rows if rows.size else None,
+            item_counts=None)
+        with self._lock:
+            self._obs[exchange_id] = obs
+        return obs
+
     # ------------------------------------------------------------------
     def get(self, exchange_id: int) -> Optional[ExchangeObservation]:
         with self._lock:

@@ -134,8 +134,47 @@ def dump_markdown() -> str:
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
     lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC,
               "", _SCHEDULING_DOC, "", _OBSERVABILITY_DOC, "",
-              _PERF_TUNING_DOC, "", _SHUFFLE_DOC, "", _ADAPTIVE_DOC]
+              _PERF_TUNING_DOC, "", _SHUFFLE_DOC, "", _ADAPTIVE_DOC,
+              "", _RECOVERY_DOC]
     return "\n".join(lines)
+
+
+_RECOVERY_DOC = """\
+## Stage-level checkpointing & crash recovery
+
+The `recovery.*` confs (table above) configure durable stage
+checkpoints (`spark_rapids_tpu/recovery/`, docs/recovery.md):
+
+* **Checkpoint writes** — with `recovery.enabled`, every exchange the
+  engine finishes materializing is persisted under
+  `recovery.dir/<query_fingerprint>/<exchange_fingerprint>/` as
+  CRC32C-stamped partition frames (the spill frame format, host
+  bytes — readable by the device, host-shuffle and CPU ladder rungs
+  alike) plus an atomically written JSON manifest carrying the plan
+  fingerprint, schema signatures, the partition histogram and a
+  snapshot of the result-affecting conf keys.
+* **Resume** — stage retries, degradation-ladder rungs and (with
+  `recovery.autoResume`, or explicitly via `Session.resume(plan)`) a
+  fresh process after a crash fingerprint-match the plan, verify every
+  manifest and frame CRC eagerly, skip completed exchanges by feeding
+  the checkpointed blocks through the exchange read path, and
+  re-execute only the unexecuted suffix
+  (`recovery.numStagesResumed` in `Session.last_metrics`).
+* **Quarantine, never a wrong answer** — a checkpoint failing ANY
+  validity check (frame CRC, plan fingerprint, schema signature,
+  result-affecting conf snapshot, malformed manifest) is renamed aside
+  and a `checkpoint_quarantine` event emitted; the exchange re-executes
+  from scratch.
+* **Hygiene** — `Session.close()` and scheduler shutdown sweep
+  crash-orphaned temp files, expired checkpoints
+  (`recovery.ttlSeconds`) and evict least-recently-touched query
+  directories over `recovery.maxBytes`; ENOSPC/OSError during a
+  checkpoint write disables checkpointing for the query
+  (`checkpoint_disabled` event) instead of failing it.
+* **Unified retry budget** — `fault.maxTotalAttempts` is the single
+  per-query ceiling across task retries, stage retries, shuffle
+  fallbacks and ladder rungs; crossing it emits ONE terminal
+  `attempt_budget_exhausted` event with the full attempt ledger."""
 
 
 _ADAPTIVE_DOC = """\
@@ -492,6 +531,50 @@ FAULT_QUEUE_PUT_TIMEOUT_MS = conf(
     "persistently full queue past this deadline raises TpuStageTimeout "
     "(the consumer has died or wedged) instead of busy-looping "
     "silently (0 disables)").int_conf(180000)
+FAULT_MAX_TOTAL_ATTEMPTS = conf(
+    "spark.rapids.tpu.fault.maxTotalAttempts").doc(
+    "Per-query ceiling on the TOTAL number of recovery re-executions "
+    "across every mechanism — task retries, adaptive stage retries, "
+    "shuffle host fallbacks and degradation-ladder rungs — so stacked "
+    "recovery paths cannot multiply into unbounded re-execution.  "
+    "Crossing the ceiling emits one terminal attempt_budget_exhausted "
+    "event carrying the full attempt ledger and fails the query with "
+    "AttemptBudgetExhausted (0 disables the ceiling)").int_conf(64)
+
+# --- stage-level checkpointing & crash recovery (recovery/;
+# reference: Theseus-style resumable exchange artifacts) -------------------
+RECOVERY_ENABLED = conf("spark.rapids.tpu.recovery.enabled").doc(
+    "Persist every completed exchange materialization as a durable "
+    "stage checkpoint (CRC32C-stamped partition frames + an atomically "
+    "written JSON manifest under recovery.dir/<query_fingerprint>/).  "
+    "Stage retries, degradation-ladder rungs and — with "
+    "recovery.autoResume — a fresh process after a crash resume from "
+    "the last completed checkpoint instead of re-running the whole "
+    "query").boolean_conf(False)
+RECOVERY_DIR = conf("spark.rapids.tpu.recovery.dir").doc(
+    "Directory holding durable stage checkpoints; empty uses "
+    "<system tempdir>/srt-recovery.  Must survive process restarts to "
+    "be useful for crash recovery (i.e. point it at a real disk, not a "
+    "per-process tmpdir)").string_conf("")
+RECOVERY_AUTO_RESUME = conf("spark.rapids.tpu.recovery.autoResume").doc(
+    "When recovery.enabled is on, Session.execute() transparently "
+    "fingerprint-matches the plan against existing checkpoints and "
+    "skips completed exchanges (Session.resume() does this "
+    "unconditionally).  Disable to only WRITE checkpoints, e.g. while "
+    "validating a new deployment").boolean_conf(True)
+RECOVERY_TTL_SECONDS = conf("spark.rapids.tpu.recovery.ttlSeconds").doc(
+    "Checkpoint expiry: query directories older than this are removed "
+    "by the Session.close()/scheduler-shutdown hygiene sweep (0 "
+    "disables age-based expiry)").long_conf(86400)
+RECOVERY_MAX_BYTES = conf("spark.rapids.tpu.recovery.maxBytes").doc(
+    "Cap on total checkpoint bytes under recovery.dir: the hygiene "
+    "sweep evicts least-recently-touched query directories until under "
+    "the cap (0 disables the cap)").long_conf(4 * 1024 * 1024 * 1024)
+RECOVERY_KILL_AFTER_CHECKPOINTS = conf(
+    "spark.rapids.tpu.recovery.killAfterCheckpoints").doc(
+    "Test hook: SIGKILL the process immediately after the Nth "
+    "successful checkpoint write (0 disables).  Drives the "
+    "crash-and-resume integration tests").internal().int_conf(0)
 
 # --- concurrent query scheduler (scheduler/; reference: Theseus-style
 # admission + memory arbitration across concurrent queries) ----------------

@@ -237,6 +237,21 @@ class TpuShuffleExchangeExec(TpuExec):
         from ..shuffle import device_shuffle as DS
         from ..telemetry.events import emit_event
 
+        # stage-level recovery: a valid checkpoint for this exchange
+        # (fingerprint-stamped by RecoveryManager.stamp_plan, validated
+        # + CRC-verified eagerly in try_resume) replaces the ENTIRE
+        # subtree below — the child is never executed
+        rec = getattr(ctx, "recovery", None)
+        rfp = getattr(self, "_recovery_fp", None)
+        if rec is not None and rfp is not None:
+            from ..recovery.manager import schema_signature
+
+            resumed = rec.try_resume(
+                rfp, n_out=self.n_out,
+                schema_sig=schema_signature(self.schema))
+            if resumed is not None:
+                return self._resumed_result(ctx, *resumed)
+
         child = self.children[0].execute_columnar(ctx)
         self._init_metrics(ctx)
         is_range = isinstance(self.partitioning, RangePartitioning)
@@ -500,6 +515,7 @@ class TpuShuffleExchangeExec(TpuExec):
             if i_write:
                 try:
                     _drain_child()
+                    _maybe_checkpoint()
                 except BaseException as e:  # noqa: BLE001
                     state["error"] = e
                     raise
@@ -526,6 +542,45 @@ class TpuShuffleExchangeExec(TpuExec):
                 if sem is not None:
                     sem.acquire_if_necessary()
             return store[0]
+
+        def _maybe_checkpoint():
+            """Persist the completed exchange as a durable stage
+            checkpoint (recovery/).  Runs in the writer branch right
+            after a SUCCESSFUL drain, under the injection shield (a
+            fault drill must not fire inside framework persistence),
+            and never fails the query — any error disables
+            checkpointing for the rest of the query instead."""
+            if rec is None or rfp is None \
+                    or not rec.should_checkpoint(rfp):
+                return
+            from ..data.column import device_to_host
+            from ..native import serializer
+            from ..recovery.manager import schema_signature
+
+            frames = []
+            try:
+                with F._shield():
+                    for p in range(self.n_out):
+                        plist = []
+                        for b in make(p)():
+                            hb = device_to_host(b, trim=True)
+                            plist.append((serializer.serialize(hb),
+                                          hb.num_rows))
+                        frames.append(plist)
+            except Exception as e:  # noqa: BLE001
+                rec.disable(f"checkpoint read-back failed "
+                            f"({type(e).__name__}: {e})")
+                return
+            written = rec.checkpoint_exchange(
+                rfp, schema_sig=schema_signature(self.schema),
+                n_out=self.n_out,
+                part_rows=[sum(r for _f, r in plist)
+                           for plist in frames],
+                total_bytes=stat_state["bytes"],
+                partitioning=type(self.partitioning).__name__,
+                frames=frames)
+            if written:
+                DS.GLOBAL.add("checkpointBytes", written)
 
         # drop cached pids the moment their batch is spilled off the
         # device — they are unspillable HBM and would defeat the spill.
@@ -699,6 +754,57 @@ class TpuShuffleExchangeExec(TpuExec):
         # the life of the process)
         weakref.finalize(result, _free_shuffle_buffers, fw, store,
                          on_spill, catalog, shuffle_id)
+        return result
+
+    def _resumed_result(self, ctx, manifest, parts):
+        """Build this exchange's result from checkpointed host frames
+        (already CRC-verified by ``try_resume``): readers deserialize +
+        upload on demand, the AQE handles stay intact — a resumed
+        exchange is a first-class materialized stage (exact per-
+        partition rows recorded into ``ctx.stage_stats``, so
+        coalescing/broadcast rewrites still fire; ``device_path`` is
+        False, which correctly disables segment/skew reads — there are
+        no live packed blocks to slice)."""
+        self._init_metrics(ctx)
+        stage_stats = getattr(ctx, "stage_stats", None)
+        exchange_id = (stage_stats.allocate_id()
+                       if stage_stats is not None else 0)
+        if stage_stats is not None:
+            stage_stats.record_resumed(
+                exchange_id, n_out=self.n_out,
+                part_rows=manifest.get("part_rows") or [],
+                total_bytes=int(manifest.get("total_bytes", 0)),
+                partitioning=type(self.partitioning).__name__,
+                name=self.describe())
+        schema = self.schema
+
+        def make(p, segments=None):
+            # segment (skew-split) reads need live packed device
+            # blocks; record_resumed reports device_path=False so the
+            # adaptive planner never requests them here
+            assert segments is None, \
+                "segment reads are impossible on a resumed exchange"
+
+            def it():
+                from ..data.column import host_to_device
+                from ..native import serializer
+
+                for frame in parts[p]:
+                    hb = serializer.deserialize(frame, schema)
+                    if hb.num_rows == 0:
+                        continue
+                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                    yield host_to_device(hb)
+
+            return it
+
+        result = DevicePartitionedData(
+            [make(i) for i in range(self.n_out)])
+        result.aqe_materialize = lambda: None  # nothing left to drain
+        result.aqe_read = make
+        result.aqe_exchange_id = exchange_id
+        result.aqe_device_path = False
+        result.aqe_exchange = self
         return result
 
     def describe(self):

@@ -1,0 +1,118 @@
+"""Unified per-query attempt budget.
+
+Every recovery mechanism in this engine re-executes something — task
+retries (plan/physical.py:drain_with_retry), adaptive stage retries
+(adaptive/executor.py:_materialize_stage), the device→host-shuffle and
+CPU ladder rungs (session.py), the distributed→single-process rung
+(fault/ladder.py).  Stacked, they can multiply: N task retries inside
+M stage retries inside 3 ladder rungs.  ``fault.maxTotalAttempts`` is
+the single ceiling across ALL of them: one budget per top-level query,
+armed by the outermost entry point (``Session.execute`` /
+``Session.resume`` / ``run_with_fault_tolerance``), charged at every
+re-execution site, and exhausted with ONE terminal
+``attempt_budget_exhausted`` event carrying the full attempt ledger.
+
+:class:`AttemptBudgetExhausted` deliberately does NOT subclass
+``TpuFaultError`` — the ladder must not catch it and climb another
+rung; exhaustion is terminal by definition.
+
+Scheduled queries (the concurrent scheduler's workers) never arm the
+budget: they carry private injectors and a per-query circuit breaker
+instead (scheduler/query_scheduler.py), and a process-global ledger
+would cross-charge concurrent neighbors.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class AttemptBudgetExhausted(RuntimeError):
+    """The query spent its ``fault.maxTotalAttempts`` ceiling."""
+
+    def __init__(self, msg: str, ledger: Optional[List[Dict]] = None):
+        super().__init__(msg)
+        self.ledger = list(ledger or [])
+
+
+class AttemptBudget:
+    """Process-global attempt ledger (driver-thread discipline, like
+    ``fault.stats.GLOBAL``).  ``begin`` at the outermost query entry
+    arms it; nested entries (a ladder rung re-entering
+    ``Session.execute``) see it armed and leave the ledger alone, so
+    charges accumulate across rungs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._limit = 0
+        self._armed = False
+        self._exhausted = False
+        self._ledger: List[Dict] = []
+
+    # ----- lifecycle -------------------------------------------------------
+    def begin(self, limit: int) -> bool:
+        """Arm the budget if nothing outer already owns it.  Returns
+        True when THIS caller is the owner (and must call ``end``)."""
+        with self._lock:
+            if self._armed:
+                return False
+            self._armed = True
+            self._limit = max(0, int(limit))
+            self._exhausted = False
+            self._ledger = []
+            return True
+
+    def end(self, owned: bool) -> None:
+        """Disarm (owner only — nested non-owners pass False)."""
+        if not owned:
+            return
+        with self._lock:
+            self._armed = False
+            self._exhausted = False
+            self._ledger = []
+
+    # ----- charging --------------------------------------------------------
+    def charge(self, kind: str, site: str = "") -> None:
+        """Record one re-execution attempt.  No-op when unarmed (a
+        scheduled query) or when the limit is 0 (disabled).  Raises
+        :class:`AttemptBudgetExhausted` — once, with the full ledger —
+        when the ceiling is crossed."""
+        with self._lock:
+            if not self._armed or self._limit <= 0:
+                return
+            self._ledger.append({"attempt": len(self._ledger) + 1,
+                                 "kind": kind, "site": site})
+            if len(self._ledger) <= self._limit:
+                return
+            ledger = list(self._ledger)
+            limit = self._limit
+            first_crossing = not self._exhausted
+            self._exhausted = True
+        if first_crossing:  # ONE terminal event, however often we re-raise
+            from ..telemetry.events import emit_event
+
+            emit_event("attempt_budget_exhausted", limit=limit,
+                       attempts=len(ledger), ledger=ledger)
+        raise AttemptBudgetExhausted(
+            f"fault.maxTotalAttempts={limit} exhausted after "
+            f"{len(ledger)} recovery attempts (last: {kind} at "
+            f"{site or '<unknown>'})", ledger)
+
+    # ----- introspection ---------------------------------------------------
+    def count(self) -> int:
+        with self._lock:
+            return len(self._ledger)
+
+    def armed(self) -> bool:
+        with self._lock:
+            return self._armed
+
+    def snapshot(self) -> Dict[str, int]:
+        """``fault.*``-prefixed snapshot for ``Session.last_metrics``
+        (only meaningful while armed)."""
+        with self._lock:
+            return {"fault.totalAttempts": len(self._ledger)}
+
+
+#: the process-wide instance (armed by the outermost query entry)
+GLOBAL = AttemptBudget()

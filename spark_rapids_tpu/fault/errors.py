@@ -39,6 +39,15 @@ class TpuStageCrash(TpuFaultError):
     ``fault.maxStageRetries``."""
 
 
+class TpuStorageExhausted(TpuFaultError):
+    """A spill (or other durable) write hit ENOSPC / an OSError: the
+    host filesystem under the spill directory is full or failing.  The
+    fault is *retryable* — the retry combinators may free space by
+    releasing buffers, and the degradation ladder can climb to a rung
+    that spills less — so it must surface as a typed fault, never as an
+    unhandled crash with a half-written file left behind."""
+
+
 class TpuStageTimeout(TpuFaultError):
     """A stage watchdog deadline (``fault.stageTimeoutMs``) expired, or
     a bounded producer/consumer queue made no progress past its

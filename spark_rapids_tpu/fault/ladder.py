@@ -39,8 +39,23 @@ def run_with_fault_tolerance(session, df, mesh=None, n_devices: int = 8):
     degradation ladder on exhaustion.  Returns the collected HostBatch;
     ``session.last_metrics`` carries the ``fault.*`` counters and the
     final ``degradeLevel``."""
+    from ..config import FAULT_MAX_TOTAL_ATTEMPTS
+    from .budget import GLOBAL as _budget
+
+    # arm the unified attempt budget at THIS outermost entry; the
+    # nested Session.execute on rung 1 sees it armed and leaves the
+    # ledger alone, so charges accumulate across all three rungs
+    owned = _budget.begin(session.conf.get(FAULT_MAX_TOTAL_ATTEMPTS))
+    try:
+        return _run_ladder(session, df, mesh, n_devices)
+    finally:
+        _budget.end(owned)
+
+
+def _run_ladder(session, df, mesh, n_devices: int):
     from ..config import FAULT_DEGRADE_ENABLED
     from ..parallel.runner import run_distributed
+    from .budget import GLOBAL as _budget
 
     try:
         out = run_distributed(session, df, mesh=mesh,
@@ -52,6 +67,7 @@ def run_with_fault_tolerance(session, df, mesh=None, n_devices: int = 8):
     except TpuFaultError as e:
         if not session.conf.get(FAULT_DEGRADE_ENABLED):
             raise
+        _budget.charge("ladder_single_process", site="fault.ladder")
         # carry the distributed attempt's counters across the rung —
         # Session.execute re-arms the per-query stats
         pre = _stats.snapshot()

@@ -34,6 +34,7 @@ import numpy as np
 from ..data.column import (DeviceBatch, HostBatch, device_to_host,
                            host_to_device)
 from ..telemetry.events import emit_event
+from ..utils import fsio
 from .hpq import make_spill_queue
 
 log = logging.getLogger(__name__)
@@ -136,7 +137,20 @@ class SpillableBuffer:
     def to_disk(self, directory: str) -> None:
         assert self.tier == StorageTier.HOST
         path = os.path.join(directory, f"buffer-{self.id}.srtb")
-        self._host_frame().tofile(path)
+        # atomic temp+fsync+rename: ENOSPC mid-write can never leave a
+        # half-written .srtb behind to be read back later, and the
+        # typed fault is raised BEFORE the host payload is released —
+        # the buffer stays intact on the host tier, so retry/ladder
+        # recovery still has the data
+        try:
+            fsio.atomic_write_bytes(path, self._host_frame())
+        except OSError as e:
+            from ..fault.errors import TpuStorageExhausted
+
+            raise TpuStorageExhausted(
+                f"spill to disk failed for buffer {self.id}: "
+                f"{type(e).__name__}: {e}",
+                site="spill.write.disk") from e
         self._release_host()
         self._disk_path = path
         self.tier = StorageTier.DISK
@@ -468,6 +482,35 @@ class SpillFramework:
                 victim, self.catalog.get(victim).priority)
         return victim
 
+    def sweep_orphans(self) -> int:
+        """Hygiene pass over the spill directory (``Session.close`` /
+        scheduler shutdown): remove atomic-write temp files and
+        ``.srtb`` files no live buffer references — what a crashed or
+        killed process left behind.  Returns files removed; never
+        raises."""
+        removed = fsio.sweep_tmp_files(self.spill_dir)
+        with self._lock:
+            live = {buf._disk_path
+                    for buf in self.catalog._buffers.values()
+                    if buf._disk_path}
+            try:
+                for name in os.listdir(self.spill_dir):
+                    if not name.endswith(".srtb"):
+                        continue
+                    path = os.path.join(self.spill_dir, name)
+                    if path in live:
+                        continue
+                    try:
+                        os.unlink(path)
+                        removed += 1
+                    except OSError:
+                        pass
+            except OSError:
+                pass
+        if removed:
+            log.info("spill sweep removed %d orphaned file(s)", removed)
+        return removed
+
     def _maybe_spill_host_to_disk(self) -> None:
         while self.host_bytes > self.host_limit:
             vid = self.host_queue.pop()
@@ -478,7 +521,14 @@ class SpillFramework:
                 continue
             if buf.refcount > 0:
                 continue
-            buf.to_disk(self.spill_dir)
+            try:
+                buf.to_disk(self.spill_dir)
+            except Exception:
+                # TpuStorageExhausted (disk full): the victim is still
+                # whole on the host tier — re-queue it before the typed
+                # fault surfaces, so recovery can still reach its data
+                self.host_queue.push(vid, buf.priority)
+                raise
             self.host_bytes -= buf.size
             self.metrics["spill_to_disk"] += 1
             emit_event("spill", tier="disk", bytes=buf.size,

@@ -219,6 +219,12 @@ def collect_batches(data: PartitionedData, schema: T.Schema,
                     sem.release_task()
                 if attempt == retries:
                     raise
+                # unified attempt budget: a task retry is one recovery
+                # attempt against fault.maxTotalAttempts (no-op when
+                # unarmed — scheduled queries)
+                from ..fault.budget import GLOBAL as _budget
+
+                _budget.charge("task_retry", site="drain_with_retry")
                 # backoff_base/max are always set here: retries > 0
                 # implies ctx is not None, which populated them
                 delay = backoff_delay_s(attempt, backoff_base,
@@ -1012,6 +1018,19 @@ class ShuffleExchangeExec(PhysicalPlan):
         return self.partitioning.num_partitions
 
     def execute(self, ctx):
+        # stage-checkpoint resume (recovery/): a validated checkpoint —
+        # written by ANY rung, device included; the frame format is
+        # mode-independent — replaces the whole child subtree
+        rec = getattr(ctx, "recovery", None) if ctx is not None else None
+        rfp = getattr(self, "_recovery_fp", None)
+        if rec is not None and rfp is not None:
+            from ..recovery.manager import schema_signature
+
+            resumed = rec.try_resume(
+                rfp, n_out=self.n_out,
+                schema_sig=schema_signature(self.schema))
+            if resumed is not None:
+                return self._resumed_data(ctx, *resumed)
         child = self.children[0].execute(ctx)
         self.partitioning.prepare(child, self.children[0].schema)
         store: List[List[HostBatch]] = [[] for _ in range(self.n_out)]
@@ -1024,11 +1043,63 @@ class ShuffleExchangeExec(PhysicalPlan):
                     sel = np.nonzero(pids == out_pid)[0]
                     if len(sel):
                         store[out_pid].append(batch.take(sel))
+        if rec is not None and rfp is not None:
+            self._maybe_checkpoint(rec, rfp, store)
 
         def make(out_pid):
             return lambda: iter(store[out_pid])
 
         return PartitionedData([make(i) for i in range(self.n_out)])
+
+    def _resumed_data(self, ctx, manifest, parts):
+        """Serve a checkpoint ``try_resume`` already CRC-verified:
+        deserialize each partition's frames back into HostBatches and
+        record a resumed-stage observation so downstream sizing sees
+        real numbers."""
+        from ..native import serializer
+
+        schema = self.schema
+        store = [[serializer.deserialize(f, schema) for f in frames]
+                 for frames in parts]
+        stage_stats = getattr(ctx, "stage_stats", None) \
+            if ctx is not None else None
+        if stage_stats is not None:
+            stage_stats.record_resumed(
+                stage_stats.allocate_id(), n_out=self.n_out,
+                part_rows=manifest.get("part_rows") or [],
+                total_bytes=int(manifest.get("total_bytes", 0)),
+                partitioning=type(self.partitioning).__name__,
+                name=self.describe())
+
+        def make(out_pid):
+            return lambda: iter(store[out_pid])
+
+        return PartitionedData([make(i) for i in range(self.n_out)])
+
+    def _maybe_checkpoint(self, rec, rfp, store) -> None:
+        """Persist the completed host exchange as a durable stage
+        checkpoint; any failure disables checkpointing for the rest of
+        the query (recovery is an optimization, never a failure mode)."""
+        if not rec.should_checkpoint(rfp):
+            return
+        from ..native import serializer
+        from ..recovery.manager import schema_signature
+
+        try:
+            frames = [[(serializer.serialize(b), b.num_rows)
+                       for b in plist] for plist in store]
+        except Exception as e:  # noqa: BLE001
+            rec.disable(f"checkpoint serialization failed "
+                        f"({type(e).__name__}: {e})")
+            return
+        rec.checkpoint_exchange(
+            rfp, schema_sig=schema_signature(self.schema),
+            n_out=self.n_out,
+            part_rows=[sum(r for _f, r in plist) for plist in frames],
+            total_bytes=sum(int(f.nbytes)
+                            for plist in frames for f, _r in plist),
+            partitioning=type(self.partitioning).__name__,
+            frames=frames)
 
     def describe(self):
         return f"ShuffleExchange[{self.partitioning.describe()}]"

@@ -1,0 +1,19 @@
+"""Stage-level checkpointing & crash recovery.
+
+Durable checkpoints at exchange materialization points — the natural
+recovery boundary Theseus-class engines exploit (PAPERS.md): a shuffle
+write that finished is a complete, partition-addressed artifact, so a
+retry, a lower degradation-ladder rung, or an entirely fresh process
+can resume from it instead of re-running the whole query.
+
+* :mod:`spark_rapids_tpu.recovery.store` — the on-disk layout:
+  CRC32C-stamped partition frames (the spill frame format) plus an
+  atomically written JSON manifest per exchange.  Pure
+  filesystem/numpy code — NO jax (lint-enforced), so a crashed device
+  process's checkpoints are readable by any rung, CPU included.
+* :mod:`spark_rapids_tpu.recovery.manager` — policy: plan/query
+  fingerprints, resume validation (manifest + CRC + conf snapshot,
+  quarantine on ANY doubt), checkpoint writes, hygiene sweeps.
+"""
+from .manager import RecoveryManager, sweep_recovery_dir  # noqa: F401
+from .store import CheckpointStore  # noqa: F401

@@ -1,0 +1,341 @@
+"""Recovery policy: fingerprints, resume validation, checkpoint writes.
+
+One :class:`RecoveryManager` serves one top-level query END TO END —
+``Session.execute``/``Session.resume`` create it before the degradation
+ladder and thread it through every rung, so resume counters accumulate
+across the device, host-shuffle and CPU rungs (a rung that resumes 2
+checkpointed exchanges reports ``recovery.numStagesResumed=2`` even if
+the previous rung wrote them).
+
+Fingerprints are derived from the HOST physical plan, which is
+rung-invariant by construction: ``Planner(conf).plan(optimize(plan))``
+is both the pre-override plan of the native path and exactly what
+``cpu_exec_plan`` re-plans on the bottom rung, and the TPU exchange
+keeps its originating host exchange node (``TpuShuffleExchangeExec
+.plan``).  The query fingerprint additionally folds in leaf DATA
+identity (content checksums of in-memory batches, path+size+mtime of
+scanned files) — two same-shape plans over different data must never
+fingerprint-match, or resume would serve the wrong rows.
+
+Validation is paranoid on purpose: a checkpoint failing ANY check
+(plan fingerprint, schema signature, result-affecting conf snapshot,
+frame CRC, manifest shape) is quarantined — renamed aside with a
+``checkpoint_quarantine`` event — and the exchange re-executes from
+scratch.  Wrong answers are not an outcome; at worst, recovery buys
+nothing.
+
+No jax in this module (lint-enforced): everything here is host policy
+over numpy frames and JSON.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import signal
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import (RECOVERY_AUTO_RESUME, RECOVERY_DIR,
+                      RECOVERY_ENABLED, RECOVERY_KILL_AFTER_CHECKPOINTS,
+                      RECOVERY_MAX_BYTES, RECOVERY_TTL_SECONDS)
+from ..telemetry.events import emit_event
+from .store import CheckpointStore
+
+log = logging.getLogger(__name__)
+
+#: conf keys whose value changes the RESULT a plan produces — a
+#: checkpoint taken under different values must not be resumed (the
+#: re-executed suffix would combine data from two semantics)
+RESULT_CONF_KEYS = (
+    "spark.rapids.tpu.sql.enabled",
+    "spark.rapids.tpu.sql.incompatibleOps.enabled",
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled",
+    "spark.rapids.tpu.sql.castStringToInteger.enabled",
+    "spark.rapids.tpu.sql.castStringToFloat.enabled",
+    "spark.rapids.tpu.sql.castStringToTimestamp.enabled",
+)
+
+#: exchange node types that carry checkpoints (the TPU exec and its
+#: host analogue — matched by name so this module imports neither)
+_EXCHANGE_TYPE_NAMES = ("TpuShuffleExchangeExec", "ShuffleExchangeExec")
+
+
+def resolve_root(conf) -> str:
+    d = conf.get(RECOVERY_DIR)
+    if d:
+        return d
+    return os.path.join(tempfile.gettempdir(), "srt-recovery")
+
+
+def schema_signature(schema) -> List[str]:
+    """Stable textual signature of an exchange's output schema
+    (``name:dtype[ not null]`` per field) — JSON-safe, order-sensitive."""
+    return [repr(f) for f in schema.fields]
+
+
+def _digest(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()[:24]
+
+
+def _leaf_material(node, out: List[str]) -> None:
+    """Collect leaf DATA identity in preorder: content checksums for
+    in-memory relations (``.batches``), path+size+mtime for file scans
+    (``.files``) — duck-typed so io/ scan execs need no registration."""
+    batches = getattr(node, "batches", None)
+    if batches is not None:
+        from ..fault.integrity import checksum_host_batch
+
+        for b in batches:
+            out.append(f"batch:{checksum_host_batch(b)}")
+    files = getattr(node, "files", None)
+    if isinstance(files, (list, tuple)):
+        for p in files:
+            try:
+                st = os.stat(p)
+                out.append(f"file:{p}:{st.st_size}:{st.st_mtime_ns}")
+            except (OSError, TypeError):
+                out.append(f"file:{p}:?")
+    for c in getattr(node, "children", ()):
+        _leaf_material(c, out)
+
+
+def _exchange_key(node) -> Optional[str]:
+    """The rung-invariant subtree string of an exchange node, or None
+    for non-exchange nodes.  The TPU exec fingerprints via its
+    ORIGINATING host exchange (``.plan`` — overrides keep the host
+    subtree intact underneath), the host exec via itself."""
+    name = type(node).__name__
+    if name not in _EXCHANGE_TYPE_NAMES:
+        return None
+    host = getattr(node, "plan", None)
+    target = host if host is not None else node
+    return target.tree_string()
+
+
+class RecoveryManager:
+    """Per-query checkpoint/resume policy (driver-thread discipline)."""
+
+    def __init__(self, conf, *, force_resume: bool = False):
+        self.conf = conf
+        enabled = bool(conf.get(RECOVERY_ENABLED))
+        #: checkpoint WRITES allowed (dropped on ENOSPC/any write error)
+        self.write_enabled = enabled
+        #: checkpoint READS allowed (``Session.resume`` forces them on
+        #: even when ``recovery.autoResume`` is off)
+        self.resume_enabled = enabled and (
+            force_resume or bool(conf.get(RECOVERY_AUTO_RESUME)))
+        self.store = CheckpointStore(resolve_root(conf))
+        self.query_fp: Optional[str] = None
+        self._conf_snapshot = {
+            k: repr(conf.get_key(k)) for k in RESULT_CONF_KEYS}
+        self._kill_after = int(
+            conf.get(RECOVERY_KILL_AFTER_CHECKPOINTS) or 0)
+        #: exchange fps THIS query checkpointed — a later ladder rung of
+        #: the same query may always resume them, independent of
+        #: ``recovery.autoResume`` (which governs cross-process resume)
+        self._own_checkpoints: set = set()
+        self._writes = 0
+        self._counters = {"numStagesResumed": 0,
+                          "numCheckpointsWritten": 0,
+                          "checkpointBytes": 0,
+                          "numQuarantined": 0}
+
+    # ----- fingerprints ----------------------------------------------------
+    def attach_query(self, plan) -> None:
+        """Fingerprint the query from its HOST physical plan + leaf data
+        identity and remember it for every later stamp/resume/write.
+        Nondeterministic plans decline recovery entirely (a resumed
+        prefix and a re-executed suffix would disagree on rand() and
+        friends).  Never fails the query."""
+        if not (self.write_enabled or self.resume_enabled):
+            return
+        try:
+            from ..adaptive.executor import _has_nondeterministic
+            from ..plan.optimizer import optimize
+            from ..plan.planner import Planner
+
+            host_phys = Planner(self.conf).plan(optimize(plan))
+            if _has_nondeterministic(host_phys):
+                log.debug("recovery declined: nondeterministic plan")
+                self.write_enabled = self.resume_enabled = False
+                return
+            material: List[str] = []
+            _leaf_material(host_phys, material)
+            self.query_fp = _digest(
+                host_phys.tree_string() + "\n" + "\n".join(material))
+        except Exception:  # noqa: BLE001 - recovery must never fail a query
+            log.warning("recovery disabled: query fingerprint failed",
+                        exc_info=True)
+            self.write_enabled = self.resume_enabled = False
+
+    def stamp_plan(self, phys) -> int:
+        """Preorder walk stamping ``_recovery_fp`` on every exchange
+        node: sha256 of the host exchange subtree string plus its
+        occurrence index (identical subtrees — self-joins — stay
+        distinct, and the preorder position is rung-invariant because
+        every rung plans the same host tree shape).  Idempotent; copies
+        made by ``with_new_children`` inherit the attribute."""
+        if self.query_fp is None:
+            return 0
+        seen: Dict[str, int] = {}
+        stamped = 0
+
+        def visit(node):
+            nonlocal stamped
+            key = _exchange_key(node)
+            if key is not None:
+                idx = seen.get(key, 0)
+                seen[key] = idx + 1
+                node._recovery_fp = _digest(f"{key}#{idx}")
+                stamped += 1
+            for c in getattr(node, "children", ()):
+                visit(c)
+
+        visit(phys)
+        return stamped
+
+    # ----- resume ----------------------------------------------------------
+    def try_resume(self, exchange_fp: str, *, n_out: int,
+                   schema_sig: List[str]
+                   ) -> Optional[Tuple[Dict, List[List[np.ndarray]]]]:
+        """Return ``(manifest, frames_per_partition)`` when a VALID
+        checkpoint exists for this exchange, else None.  Every frame is
+        CRC-verified here, eagerly — after this returns non-None the
+        caller skips the exchange's child entirely, so there is no
+        later fallback point.  Any invalidity quarantines the
+        checkpoint (event + rename aside) and returns None: full
+        re-execution, never a wrong answer."""
+        if self.query_fp is None:
+            return None
+        if not self.resume_enabled \
+                and exchange_fp not in self._own_checkpoints:
+            return None
+        d = self.store.exchange_dir(self.query_fp, exchange_fp)
+        if not os.path.isfile(os.path.join(d, "manifest.json")):
+            return None
+        try:
+            m = self.store.read_manifest(d)
+            if m.get("plan_fingerprint") != exchange_fp:
+                raise ValueError(
+                    "stale plan fingerprint: manifest "
+                    f"{m.get('plan_fingerprint')!r} != {exchange_fp!r}")
+            if m.get("query_fingerprint") != self.query_fp:
+                raise ValueError("query fingerprint mismatch")
+            if m.get("schema") != list(schema_sig):
+                raise ValueError("schema signature mismatch")
+            if int(m.get("n_out", -1)) != int(n_out):
+                raise ValueError(
+                    f"fan-out mismatch: {m.get('n_out')} != {n_out}")
+            if m.get("conf") != self._conf_snapshot:
+                raise ValueError(
+                    "result-affecting conf changed since checkpoint: "
+                    f"{m.get('conf')} != {self._conf_snapshot}")
+            frames = self.store.load_frames(d, m, n_out)
+        except Exception as e:  # noqa: BLE001 - ANY doubt quarantines
+            moved = self.store.quarantine(d)
+            self._counters["numQuarantined"] += 1
+            emit_event("checkpoint_quarantine", exchange=exchange_fp,
+                       reason=f"{type(e).__name__}: {e}",
+                       quarantined_to=moved or "")
+            log.warning(
+                "checkpoint for exchange %s quarantined (%s: %s) — "
+                "re-executing from scratch", exchange_fp,
+                type(e).__name__, e)
+            return None
+        self._counters["numStagesResumed"] += 1
+        emit_event("checkpoint_resume", exchange=exchange_fp,
+                   partitions=n_out,
+                   rows=int(m.get("total_rows", 0)),
+                   bytes=int(m.get("total_bytes", 0)))
+        return m, frames
+
+    # ----- checkpoint writes -----------------------------------------------
+    def should_checkpoint(self, exchange_fp: str) -> bool:
+        return (self.write_enabled and self.query_fp is not None
+                and not self.store.has_manifest(self.query_fp,
+                                                exchange_fp))
+
+    def checkpoint_exchange(self, exchange_fp: str, *,
+                            schema_sig: List[str], n_out: int,
+                            part_rows: List[int], total_bytes: int,
+                            partitioning: str,
+                            frames: List[List[Tuple[np.ndarray, int]]]
+                            ) -> int:
+        """Persist one completed exchange; returns frame bytes written
+        (0 when skipped or failed).  A write failure — ENOSPC, a dying
+        disk, anything — disables checkpointing for the rest of the
+        query with a ``checkpoint_disabled`` event and lets the query
+        run on; checkpointing is an optimization, never a failure
+        mode."""
+        if not self.should_checkpoint(exchange_fp):
+            return 0
+        total_rows = int(sum(int(r) for r in part_rows))
+        manifest = {
+            "query_fingerprint": self.query_fp,
+            "plan_fingerprint": exchange_fp,
+            "schema": list(schema_sig),
+            "n_out": int(n_out),
+            "part_rows": [int(r) for r in part_rows],
+            "total_rows": total_rows,
+            "total_bytes": int(total_bytes),
+            "partitioning": partitioning,
+            "conf": dict(self._conf_snapshot),
+        }
+        try:
+            written = self.store.write_exchange(
+                self.query_fp, exchange_fp, manifest, frames)
+        except OSError as e:
+            self.disable(f"checkpoint write failed "
+                         f"({type(e).__name__}: {e})")
+            return 0
+        except Exception as e:  # noqa: BLE001 - never fail the query
+            self.disable(f"checkpoint write failed "
+                         f"({type(e).__name__}: {e})")
+            return 0
+        self._writes += 1
+        self._own_checkpoints.add(exchange_fp)
+        self._counters["numCheckpointsWritten"] += 1
+        self._counters["checkpointBytes"] += written
+        emit_event("checkpoint_write", exchange=exchange_fp,
+                   partitions=n_out, rows=total_rows, bytes=written)
+        if self._kill_after > 0 and self._writes >= self._kill_after:
+            # crash-drill hook (internal conf): die HARD right after
+            # the checkpoint committed, like a real power-cut
+            log.warning("recovery.killAfterCheckpoints=%d reached — "
+                        "SIGKILL", self._kill_after)
+            os.kill(os.getpid(), signal.SIGKILL)
+        return written
+
+    def disable(self, reason: str) -> None:
+        """Turn off checkpoint WRITES for the rest of the query (reads
+        stay valid — existing checkpoints are untouched)."""
+        if not self.write_enabled:
+            return
+        self.write_enabled = False
+        emit_event("checkpoint_disabled", reason=reason)
+        log.warning("checkpointing disabled for this query: %s", reason)
+
+    # ----- surfaces --------------------------------------------------------
+    def metrics(self) -> Dict[str, int]:
+        return {f"recovery.{k}": v for k, v in self._counters.items()}
+
+    def sweep(self) -> Dict[str, int]:
+        return self.store.sweep(
+            ttl_seconds=int(self.conf.get(RECOVERY_TTL_SECONDS) or 0),
+            max_bytes=int(self.conf.get(RECOVERY_MAX_BYTES) or 0))
+
+
+def sweep_recovery_dir(conf) -> Dict[str, int]:
+    """Hygiene sweep of the recovery root for ``Session.close()`` and
+    scheduler shutdown: crash-orphaned temp files, expired query dirs
+    (``recovery.ttlSeconds``), LRU eviction over ``recovery.maxBytes``.
+    Cheap no-op when the root does not exist; never raises."""
+    root = resolve_root(conf)
+    if not os.path.isdir(root):
+        return {"removedTmpFiles": 0, "removedQueryDirs": 0}
+    return CheckpointStore(root).sweep(
+        ttl_seconds=int(conf.get(RECOVERY_TTL_SECONDS) or 0),
+        max_bytes=int(conf.get(RECOVERY_MAX_BYTES) or 0))

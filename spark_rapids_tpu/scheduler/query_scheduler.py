@@ -557,6 +557,14 @@ class QueryScheduler:
             self._dispatcher.join(timeout)
         for t in workers:
             t.join(timeout)
+        if not already:
+            # end-of-life storage hygiene (shared with Session.close):
+            # orphaned spill files + expired/over-cap checkpoint dirs
+            try:
+                self.session.sweep_storage()
+            except Exception:  # noqa: BLE001 — shutdown must not raise
+                log.warning("shutdown storage sweep failed",
+                            exc_info=True)
 
     @property
     def active_count(self) -> int:

@@ -186,7 +186,8 @@ class Session:
 
     def prepare_execution(self, plan: L.LogicalPlan, *,
                           scheduled: bool = False, cancel_token=None,
-                          force_host_shuffle: bool = False):
+                          force_host_shuffle: bool = False,
+                          recovery=None):
         """Plan + capture + context — the shared front half of execute
         paths (incl. the ML columnar export).
 
@@ -219,10 +220,25 @@ class Session:
                 pass
         if self.capture_plans:
             self._executed_plans.append(phys)
+        if recovery is None:
+            # direct callers that bypass the ladder (scheduled queries,
+            # the ML columnar export) still checkpoint + auto-resume
+            from .config import RECOVERY_ENABLED
+
+            if self.conf.get(RECOVERY_ENABLED):
+                from .recovery import RecoveryManager
+
+                recovery = RecoveryManager(self.conf)
+                recovery.attach_query(plan)
         ctx = ExecContext(self.conf, self, scheduled=scheduled,
                           cancel_token=cancel_token,
                           force_host_shuffle=force_host_shuffle)
         ctx.kernel_cache_mark = kc_mark
+        if recovery is not None:
+            # stamp every exchange with its rung-invariant plan
+            # fingerprint (re-stamping a cached tree is idempotent)
+            recovery.stamp_plan(phys)
+            ctx.recovery = recovery
         return phys, ctx
 
     def execute(self, plan: L.LogicalPlan) -> HostBatch:
@@ -233,28 +249,68 @@ class Session:
         re-executes on the CPU-exec plan (bit-identical by the oracle
         contract) instead of raising, and ``fault.degradeLevel``
         records the rung (``fault.degrade.enabled`` gates this)."""
+        return self._execute_with_ladder(plan, force_resume=False)
+
+    def resume(self, plan: L.LogicalPlan) -> HostBatch:
+        """Crash-recovery entry point: execute ``plan``, resuming from
+        any durable stage checkpoints a previous (crashed or killed)
+        process left under ``recovery.dir`` — regardless of
+        ``recovery.autoResume``.  Requires ``recovery.enabled``;
+        checkpoints that fail validation (plan/query fingerprint,
+        schema signature, result-affecting conf snapshot, per-frame
+        CRC32C) are quarantined with a ``checkpoint_quarantine`` event
+        and their stages simply re-execute — a stale or corrupt
+        checkpoint can cost time, never correctness."""
+        return self._execute_with_ladder(plan, force_resume=True)
+
+    def _execute_with_ladder(self, plan: L.LogicalPlan, *,
+                             force_resume: bool) -> HostBatch:
+        """The shared body of ``execute``/``resume``: arm the per-query
+        attempt budget (``fault.maxTotalAttempts`` — one ceiling across
+        task retries, stage retries, shuffle fallbacks and ladder
+        rungs), create the ONE RecoveryManager the whole ladder shares
+        (checkpoints written on a failed rung are resumed by the next),
+        then run the degradation ladder."""
+        from .config import FAULT_MAX_TOTAL_ATTEMPTS, RECOVERY_ENABLED
+        from .fault.budget import GLOBAL as _budget
         from .fault.errors import TpuFaultError
 
-        try:
-            return self._execute_native(plan)
-        except TpuFaultError as e:
-            from .config import FAULT_DEGRADE_ENABLED, SHUFFLE_MODE
+        recovery = None
+        if self.conf.get(RECOVERY_ENABLED):
+            from .recovery import RecoveryManager
 
-            if self.device_manager is None or \
-                    not self.conf.get(FAULT_DEGRADE_ENABLED):
-                raise
-            # ladder rung between native and CPU: re-execute with every
-            # exchange forced onto the host-staged shuffle path — the
-            # recovery for faults confined to the device-resident data
-            # path (a device-targeted corruption drill, HBM exhaustion
-            # during a packed write).  Skipped when the conf already
-            # pins host shuffle (the rung would change nothing).
-            if (self.conf.get(SHUFFLE_MODE) or "auto").lower() != "host":
-                try:
-                    return self._execute_host_shuffle_rung(plan, e)
-                except TpuFaultError as e2:
-                    return self._execute_degraded_cpu(plan, e2)
-            return self._execute_degraded_cpu(plan, e)
+            recovery = RecoveryManager(self.conf,
+                                       force_resume=force_resume)
+            recovery.attach_query(plan)
+        owned = _budget.begin(self.conf.get(FAULT_MAX_TOTAL_ATTEMPTS))
+        try:
+            try:
+                return self._execute_native(plan, recovery=recovery)
+            except TpuFaultError as e:
+                from .config import FAULT_DEGRADE_ENABLED, SHUFFLE_MODE
+
+                if self.device_manager is None or \
+                        not self.conf.get(FAULT_DEGRADE_ENABLED):
+                    raise
+                # ladder rung between native and CPU: re-execute with
+                # every exchange forced onto the host-staged shuffle
+                # path — the recovery for faults confined to the
+                # device-resident data path (a device-targeted
+                # corruption drill, HBM exhaustion during a packed
+                # write).  Skipped when the conf already pins host
+                # shuffle (the rung would change nothing).
+                if (self.conf.get(SHUFFLE_MODE)
+                        or "auto").lower() != "host":
+                    try:
+                        return self._execute_host_shuffle_rung(
+                            plan, e, recovery=recovery)
+                    except TpuFaultError as e2:
+                        return self._execute_degraded_cpu(
+                            plan, e2, recovery=recovery)
+                return self._execute_degraded_cpu(
+                    plan, e, recovery=recovery)
+        finally:
+            _budget.end(owned)
 
     def _finalize_metrics(self, ctx, phys=None,
                           preserve: Optional[Dict] = None) -> None:
@@ -281,8 +337,19 @@ class Session:
         stage_stats = getattr(ctx, "stage_stats", None)
         if stage_stats is not None:
             merged.update(stage_stats.metrics())
+        recovery = getattr(ctx, "recovery", None)
+        if recovery is not None:
+            # recovery.* counters accumulate across ladder rungs (one
+            # manager per query), so later rungs report the running sum
+            merged.update(recovery.metrics())
         if preserve:
             merged.update(preserve)
+        from .fault.budget import GLOBAL as _attempt_budget
+
+        # after ``preserve``: the armed ledger's live count supersedes
+        # any stale fault.totalAttempts carried from a failed rung
+        if _attempt_budget.armed():
+            merged.update(_attempt_budget.snapshot())
         if self.device_manager is not None:
             if not getattr(ctx, "scheduled", False):
                 # scheduled queries never reset (or report) the
@@ -342,10 +409,11 @@ class Session:
     def _execute_native(self, plan: L.LogicalPlan, *,
                         scheduled: bool = False, cancel_token=None,
                         ctx_sink: Optional[Dict] = None,
-                        force_host_shuffle: bool = False) -> HostBatch:
+                        force_host_shuffle: bool = False,
+                        recovery=None) -> HostBatch:
         phys, ctx = self.prepare_execution(
             plan, scheduled=scheduled, cancel_token=cancel_token,
-            force_host_shuffle=force_host_shuffle)
+            force_host_shuffle=force_host_shuffle, recovery=recovery)
         if ctx_sink is not None:
             ctx_sink["phys"] = phys
             ctx_sink["ctx"] = ctx
@@ -376,18 +444,25 @@ class Session:
                     self.shuffle_catalog.unregister_shuffle(sid)
 
     def _execute_host_shuffle_rung(self, plan: L.LogicalPlan,
-                                   cause) -> HostBatch:
+                                   cause, recovery=None) -> HostBatch:
         """The device-shuffle → host-shuffle ladder rung: re-execute
         the whole query natively with every exchange forced onto the
         host-staged path.  Injectors stay ARMED (re-armed from conf by
         the new ExecContext) — a drill that also hits the host path
         fails this rung and falls through to the CPU rung.  Fault
         counters from the failed device attempt stay visible in
-        ``last_metrics`` whether this rung succeeds or not."""
+        ``last_metrics`` whether this rung succeeds or not.  With
+        recovery enabled, exchanges the failed attempt checkpointed are
+        RESUMED here instead of re-executed (host frames are
+        mode-independent), and this rung's own completed exchanges
+        checkpoint for the CPU rung below."""
+        from .fault.budget import GLOBAL as _budget
         from .fault.errors import TpuFaultError
         from .fault.stats import GLOBAL as _fault_stats
         from .fault.stats import fault_summary
         from .telemetry.events import emit_event
+
+        _budget.charge("ladder_host_shuffle", site="session.ladder")
 
         # the failed attempt's counters were finalized into
         # last_metrics by _execute_native's finally — carry them
@@ -420,7 +495,8 @@ class Session:
             self.last_metrics = merged
 
         try:
-            out = self._execute_native(plan, force_host_shuffle=True)
+            out = self._execute_native(plan, force_host_shuffle=True,
+                                       recovery=recovery)
         except TpuFaultError:
             # keep the device attempt (and this rung's fallback count)
             # visible to the CPU rung: both in last_metrics and in the
@@ -446,18 +522,23 @@ class Session:
         return out
 
     def _execute_degraded_cpu(self, plan: L.LogicalPlan,
-                              cause) -> HostBatch:
+                              cause, recovery=None) -> HostBatch:
         """The bottom ladder rung: re-execute the WHOLE query on the
         host engine (no TPU overrides), with every injector disarmed —
         the fallback must run clean.  Fault counters from the failed
         native attempt are preserved in ``last_metrics`` so the
-        degradation stays visible."""
+        degradation stays visible.  Checkpoints written by the failed
+        device/host rungs resume here too: the host plan subtree
+        fingerprints are rung-invariant and the frames are plain
+        serialized HostBatches."""
+        from .fault.budget import GLOBAL as _budget
         from .fault.injector import install_fault_injector
         from .fault.stats import DEGRADE_CPU, GLOBAL as _fault_stats
         from .memory.retry import install_injector
         from .plan.overrides import cpu_exec_plan
         from .telemetry.events import emit_event
 
+        _budget.charge("ladder_cpu", site="session.ladder")
         install_injector(None)
         install_fault_injector(None)
         _fault_stats.set_max("degradeLevel", DEGRADE_CPU)
@@ -472,6 +553,9 @@ class Session:
                  if k.startswith(("fault.", "retry."))}
         phys = cpu_exec_plan(self.conf, plan)
         ctx = ExecContext(self.conf, None)
+        if recovery is not None:
+            recovery.stamp_plan(phys)
+            ctx.recovery = recovery
         data = phys.execute(ctx)
         schema = phys.schema if len(phys.schema) else plan.schema
         out = collect_batches(data, schema, ctx)
@@ -516,6 +600,35 @@ class Session:
             sched, self._scheduler = self._scheduler, None
         if sched is not None:
             sched.shutdown()
+
+    def sweep_storage(self) -> Dict[str, int]:
+        """Durable-storage hygiene (shared by :meth:`close` and the
+        scheduler's shutdown): remove orphaned spill files a crashed
+        process left behind, crash-orphaned checkpoint temp files,
+        checkpoint query dirs past ``recovery.ttlSeconds`` and — over
+        ``recovery.maxBytes`` — the least-recently-touched checkpoint
+        dirs.  Never raises; returns removal counts."""
+        out: Dict[str, int] = {}
+        try:
+            if self.spill_framework is not None:
+                out["removedSpillOrphans"] = \
+                    self.spill_framework.sweep_orphans()
+        except Exception:  # noqa: BLE001 - hygiene must not mask exit
+            log.warning("spill orphan sweep failed", exc_info=True)
+        try:
+            from .recovery.manager import sweep_recovery_dir
+
+            out.update(sweep_recovery_dir(self.conf))
+        except Exception:  # noqa: BLE001
+            log.warning("recovery sweep failed", exc_info=True)
+        return out
+
+    def close(self) -> None:
+        """End-of-life hygiene: stop the scheduler (joining its
+        threads) and :meth:`sweep_storage`.  Idempotent — the session
+        remains usable for further queries afterwards."""
+        self.shutdown_scheduler()
+        self.sweep_storage()
 
     def execute_columnar(self, plan: L.LogicalPlan):
         """Zero-copy device export: returns the list of DeviceBatches of

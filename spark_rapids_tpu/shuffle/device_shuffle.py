@@ -42,7 +42,7 @@ from ..ops.kernels.gather import gather_batch, gather_column
 # ==========================================================================
 class ShuffleStats:
     _KEYS = ("deviceBytes", "hostBytes", "collectiveTimeNs",
-             "numFallbacks")
+             "numFallbacks", "checkpointBytes")
 
     def __init__(self):
         self._lock = threading.Lock()

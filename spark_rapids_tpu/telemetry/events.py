@@ -24,7 +24,20 @@ noteworthy engine transition emits one flat JSON record:
 ``aqe_coalesce_partitions`` — AQE merged adjacent small partitions,
 ``aqe_reservation_rebase`` — the scheduler's HBM reservation shrank to
                        observed stage output,
-``aqe_final_plan``   — adaptive execution finished; the final plan.
+``aqe_final_plan``   — adaptive execution finished; the final plan,
+``checkpoint_write`` — a completed exchange persisted as a durable
+                       stage checkpoint (recovery/),
+``checkpoint_resume`` — a validated checkpoint replaced a stage's
+                       re-execution (retry, ladder rung, or a fresh
+                       process after a crash),
+``checkpoint_quarantine`` — a checkpoint failed validation (stale
+                       fingerprint, schema/conf mismatch, CRC) and was
+                       renamed aside; the stage re-executes,
+``checkpoint_disabled`` — checkpoint writes turned off for the rest of
+                       the query (ENOSPC or any write failure),
+``attempt_budget_exhausted`` — the per-query ``fault.maxTotalAttempts``
+                       ceiling was crossed; carries the full attempt
+                       ledger (terminal, emitted exactly once).
 
 Emission contract: call sites OUTSIDE ``telemetry/`` must only use
 :func:`emit_event`, which is exception-safe (never raises, never

@@ -177,6 +177,18 @@ class QueryProfile:
             lines.append("-- Adaptive execution --")
             for k in sorted(aqe):
                 lines.append(f"  {k}: {aqe[k]}")
+        rec = {k.split(".", 1)[1]: v for k, v in self.metrics.items()
+               if k.startswith("recovery.")}
+        if rec:
+            # recovery. is a counter family too; a resumed query must
+            # be visibly resumed — the header carries how many stages
+            # were served from checkpoints instead of re-executed
+            resumed = rec.get("numStagesResumed", 0)
+            lines.append("")
+            lines.append("-- Stage recovery "
+                         f"(resumedFromStage={resumed}) --")
+            for k in sorted(rec):
+                lines.append(f"  {k}: {rec[k]}")
         ex: Dict[str, Dict[str, int]] = {}
         for k, v in self.metrics.items():
             if k.startswith("shuffle.exchange") and k.count(".") >= 2:

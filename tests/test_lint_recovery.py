@@ -1,0 +1,204 @@
+"""AST lint: the durability discipline of the recovery subsystem.
+
+Three contracts, enforced at the source level so a refactor cannot
+silently regress them:
+
+* **Every durable write is atomic.**  Nothing under
+  ``spark_rapids_tpu/recovery/`` or in ``memory/spill.py`` may write a
+  file directly (write-mode ``open``, ``tofile``): all persistence goes
+  through the shared ``utils/fsio`` temp+fsync+``os.replace`` helpers,
+  so a crash can leave an orphan temp file but never a truncated
+  artifact a reader could mistake for valid data.
+* **No deserialization before the CRC.**  Checkpoint frames are
+  verified (``verify_frame``) in the same function that reads them off
+  disk, and ``recovery/`` never deserializes frames at all — decoding
+  happens at the call sites, strictly AFTER ``load_frames`` returned
+  verified bytes.  Manifest readers must check the plan fingerprint.
+* **recovery/ is host-only.**  Checkpoint frames are host numpy
+  buffers readable by every ladder rung (device, host-shuffle, CPU);
+  importing jax here would tie durability to an accelerator runtime.
+"""
+import ast
+import os
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "spark_rapids_tpu")
+RECOVERY = os.path.join(PKG, "recovery")
+
+#: the blessed durable-write entry points (utils/fsio.py)
+ATOMIC_HELPERS = {"atomic_write_bytes", "atomic_write_json"}
+
+
+def _parse(path):
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _recovery_modules():
+    for fn in sorted(os.listdir(RECOVERY)):
+        if fn.endswith(".py"):
+            yield fn, _parse(os.path.join(RECOVERY, fn))
+
+
+def _audited_modules():
+    """recovery/* plus the spill write path share the discipline."""
+    yield from _recovery_modules()
+    yield "memory/spill.py", _parse(os.path.join(PKG, "memory", "spill.py"))
+
+
+def _terminal_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _calls_in(tree):
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _functions_with_calls(tree):
+    """Yield (funcdef, calls-in-OWN-body) — nested defs own their
+    bodies (mirrors tests/test_lint_adaptive.py)."""
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        own = []
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Call):
+                own.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        yield fn, own
+
+
+def _open_mode(call):
+    """The mode string of an ``open()`` call, or None when it is not a
+    literal (non-literal modes are flagged by the caller)."""
+    if len(call.args) >= 2:
+        arg = call.args[1]
+    else:
+        arg = next((kw.value for kw in call.keywords
+                    if kw.arg == "mode"), None)
+    if arg is None:
+        return "r"  # default mode
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+# ==========================================================================
+# Atomic writes only
+# ==========================================================================
+def test_no_direct_file_writes_in_recovery_or_spill():
+    offenders = []
+    checked = 0
+    for fn, tree in _audited_modules():
+        for call in _calls_in(tree):
+            checked += 1
+            name = _terminal_name(call.func)
+            if name == "open":
+                mode = _open_mode(call)
+                if mode is None or any(c in mode for c in "wa+x"):
+                    offenders.append(
+                        f"{fn}:{call.lineno} open(mode={mode!r})")
+            elif name == "tofile":
+                offenders.append(f"{fn}:{call.lineno} .tofile()")
+    assert checked >= 80, "lint saw suspiciously little code"
+    assert not offenders, (
+        "durable writes must go through utils/fsio atomic helpers "
+        f"(temp+fsync+replace): {offenders}")
+
+
+def test_durable_writes_use_the_shared_fsio_helpers():
+    """Both the checkpoint store and the spill path must actually call
+    the shared helpers (not have quietly grown their own writer)."""
+    for path, least in ((os.path.join(RECOVERY, "store.py"), 2),
+                        (os.path.join(PKG, "memory", "spill.py"), 1)):
+        tree = _parse(path)
+        uses = [c for c in _calls_in(tree)
+                if _terminal_name(c.func) in ATOMIC_HELPERS]
+        assert len(uses) >= least, (
+            f"{path} no longer writes through utils/fsio "
+            f"({len(uses)} < {least} helper calls)")
+
+
+# ==========================================================================
+# CRC before deserialization
+# ==========================================================================
+def test_frame_reads_verify_crc_in_same_function():
+    """Any recovery/ function pulling raw frame bytes off disk
+    (``np.fromfile``) must CRC-verify them in its OWN body — not hope a
+    caller remembers to."""
+    readers = 0
+    offenders = []
+    for fn_name, tree in _recovery_modules():
+        for fn, own_calls in _functions_with_calls(tree):
+            names = {_terminal_name(c.func) for c in own_calls}
+            if "fromfile" not in names:
+                continue
+            readers += 1
+            if "verify_frame" not in names:
+                offenders.append(
+                    f"{fn_name}:{fn.name} reads frames without "
+                    "verify_frame")
+    assert readers >= 1, "recovery/ no longer reads checkpoint frames?"
+    assert not offenders, offenders
+
+
+def test_recovery_never_deserializes_frames():
+    """Deserialization happens OUTSIDE recovery/, strictly after
+    ``load_frames`` returned CRC-verified bytes — so a function here
+    calling ``deserialize`` would structurally bypass the
+    verify-before-decode ordering."""
+    offenders = []
+    for fn, tree in _recovery_modules():
+        for call in _calls_in(tree):
+            if _terminal_name(call.func) == "deserialize":
+                offenders.append(f"{fn}:{call.lineno}")
+    assert not offenders, (
+        f"recovery/ must hand out verified raw bytes only: {offenders}")
+
+
+def test_manifest_reader_checks_plan_fingerprint():
+    """Whoever consumes a manifest must validate its plan fingerprint
+    before trusting it (stale-checkpoint quarantine)."""
+    tree = _parse(os.path.join(RECOVERY, "manager.py"))
+    found = False
+    for fn, own_calls in _functions_with_calls(tree):
+        names = {_terminal_name(c.func) for c in own_calls}
+        if "read_manifest" not in names:
+            continue
+        literals = {n.value for n in ast.walk(fn)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)}
+        found = found or "plan_fingerprint" in literals
+    assert found, ("manager.py reads manifests without validating "
+                   "plan_fingerprint")
+
+
+# ==========================================================================
+# Host-only recovery
+# ==========================================================================
+def test_recovery_package_never_imports_jax():
+    offenders = []
+    for fn, tree in _recovery_modules():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for name in names:
+                if name == "jax" or name.startswith("jax."):
+                    offenders.append(f"{fn}:{node.lineno} imports {name}")
+    assert not offenders, (
+        "recovery/ must stay host-only (checkpoints are readable by "
+        f"every ladder rung, including the CPU one): {offenders}")

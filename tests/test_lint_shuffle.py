@@ -33,8 +33,12 @@ PKG = os.path.join(
 HOT_PATH_FILES = (os.path.join("shuffle", "device_shuffle.py"),
                   os.path.join("exec", "exchange.py"))
 
-#: functions that ARE the gated host-sync points of the data path
-GATED_FUNCS = {"fetch_counts", "flush", "drain_outs"}
+#: functions that ARE the gated host-sync points of the data path.
+#: _maybe_checkpoint is the stage-checkpoint writer (recovery/): a
+#: deliberate once-per-exchange d2h, conf-gated by recovery.enabled
+#: and off the hot path (it runs after the drain completed).
+GATED_FUNCS = {"fetch_counts", "flush", "drain_outs",
+               "_maybe_checkpoint"}
 
 #: names whose call synchronously materializes device data on the host
 HOST_SYNC_NAMES = {"device_get", "tolist", "item",

@@ -1,0 +1,625 @@
+"""Stage-level checkpointing & crash recovery (spark_rapids_tpu/recovery/).
+
+The central invariants:
+
+* a query with ``recovery.enabled`` persists each completed exchange as
+  a CRC32C-stamped checkpoint under ``recovery.dir``, and a later
+  execution of the SAME query (same plan, same data, same
+  result-affecting conf) resumes from it — bit-identical results with
+  ``recovery.numStagesResumed`` > 0;
+* resume validation is paranoid: a flipped frame byte, a stale plan
+  fingerprint, or a changed result-affecting conf each quarantine the
+  checkpoint (``checkpoint_quarantine`` event) and the query re-executes
+  from scratch — a bad checkpoint can cost time, never correctness;
+* a SIGKILLed process (crash drill via ``recovery.killAfterCheckpoints``)
+  leaves checkpoints a FRESH process resumes through ``Session.resume``;
+* the degradation ladder's rungs reuse the failed rungs' checkpoints;
+* ENOSPC on checkpoint writes disables checkpointing gracefully; on
+  spill writes it surfaces as typed retryable ``TpuStorageExhausted``;
+* ``fault.maxTotalAttempts`` is one attempt ceiling across every retry
+  mechanism, exhausted with ONE terminal event carrying the ledger.
+"""
+import errno
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.fault.budget import AttemptBudgetExhausted
+from spark_rapids_tpu.fault.errors import (TpuFaultError,
+                                           TpuStorageExhausted)
+from spark_rapids_tpu.plan import functions as F
+
+FAST = {
+    "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+    "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+}
+
+
+def _conf(root, **extra):
+    conf = dict(FAST)
+    conf.update({
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.recovery.dir": str(root),
+        "spark.rapids.tpu.telemetry.enabled": True,
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+    })
+    conf.update(extra)
+    return conf
+
+
+def _query(sess):
+    """A deterministic 2-table join + aggregate: multiple shuffle
+    exchanges, so partial-checkpoint scenarios exist."""
+    import numpy as np
+
+    rng = np.random.RandomState(11)
+    orders = {"o_custkey": rng.randint(0, 40, 300).tolist(),
+              "o_total": rng.rand(300).round(6).tolist()}
+    cust = {"c_custkey": list(range(40)),
+            "c_nation": rng.randint(0, 5, 40).tolist()}
+    o = sess.create_dataframe(orders, n_partitions=3)
+    c = sess.create_dataframe(cust, n_partitions=2)
+    j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+    return j.group_by("c_nation").agg(
+        F.sum("o_total").alias("rev"), F.count("o_total").alias("n"))
+
+
+def _norm(rows):
+    return sorted(
+        (tuple((None if v is None else
+                (round(v, 9) if isinstance(v, float) else v))
+               for v in r) for r in rows),
+        key=repr)
+
+
+def _batch_rows(hb):
+    return _norm(zip(*[c.to_pylist() for c in hb.columns]))
+
+
+def _events(sess, etype):
+    prof = sess.last_profile
+    assert prof is not None, "telemetry must be on for event asserts"
+    return [e for e in prof.events.snapshot() if e["event"] == etype]
+
+
+def _exchange_dirs(root):
+    out = []
+    for q in os.listdir(root):
+        qd = os.path.join(root, q)
+        if not os.path.isdir(qd):
+            continue
+        for e in os.listdir(qd):
+            if not e.startswith("quarantine-"):
+                out.append(os.path.join(qd, e))
+    return out
+
+
+# ==========================================================================
+# Checkpoint write + resume
+# ==========================================================================
+def test_checkpoint_write_then_cross_session_resume(tmp_path):
+    sess = srt.Session(_conf(tmp_path))
+    want = _norm(_query(sess).collect())
+    m = sess.last_metrics
+    assert m.get("recovery.numCheckpointsWritten", 0) >= 1, m
+    assert m.get("recovery.checkpointBytes", 0) > 0
+    assert m.get("shuffle.checkpointBytes", 0) > 0  # delta counter
+    assert _events(sess, "checkpoint_write")
+    for d in _exchange_dirs(tmp_path):
+        assert os.path.isfile(os.path.join(d, "manifest.json"))
+
+    sess2 = srt.Session(_conf(tmp_path))
+    got = _batch_rows(sess2.resume(_query(sess2).plan))
+    assert got == want
+    m2 = sess2.last_metrics
+    assert m2.get("recovery.numStagesResumed", 0) >= 1, m2
+    assert m2.get("recovery.numQuarantined", 0) == 0
+    assert _events(sess2, "checkpoint_resume")
+    # a resumed query must be visibly resumed in the profile
+    assert "resumedFromStage=" in sess2.profile_report()
+
+
+def test_auto_resume_on_plain_execute(tmp_path):
+    """``recovery.autoResume`` (default on) resumes through plain
+    ``execute`` too — ``Session.resume`` is only needed to force it."""
+    sess = srt.Session(_conf(tmp_path))
+    want = _norm(_query(sess).collect())
+    sess2 = srt.Session(_conf(tmp_path))
+    got = _norm(_query(sess2).collect())
+    assert got == want
+    assert sess2.last_metrics.get("recovery.numStagesResumed", 0) >= 1
+
+
+def test_auto_resume_off_reexecutes(tmp_path):
+    sess = srt.Session(_conf(tmp_path))
+    want = _norm(_query(sess).collect())
+    off = _conf(tmp_path,
+                **{"spark.rapids.tpu.recovery.autoResume": False})
+    sess2 = srt.Session(off)
+    got = _norm(_query(sess2).collect())
+    assert got == want
+    assert sess2.last_metrics.get("recovery.numStagesResumed", 0) == 0
+    # but an explicit resume() overrides autoResume=false
+    sess3 = srt.Session(off)
+    assert _batch_rows(sess3.resume(_query(sess3).plan)) == want
+    assert sess3.last_metrics.get("recovery.numStagesResumed", 0) >= 1
+
+
+def test_partial_checkpoint_without_manifest_is_ignored(tmp_path):
+    """Frames without a manifest (a crash mid-checkpoint) are not a
+    checkpoint at all: the manifest is the commit marker.  No resume,
+    no quarantine — the fresh run simply writes its own."""
+    sess = srt.Session(_conf(tmp_path))
+    want = _norm(_query(sess).collect())
+    for d in _exchange_dirs(tmp_path):
+        os.unlink(os.path.join(d, "manifest.json"))
+    sess2 = srt.Session(_conf(tmp_path))
+    got = _norm(_query(sess2).collect())
+    assert got == want
+    m2 = sess2.last_metrics
+    assert m2.get("recovery.numStagesResumed", 0) == 0
+    assert m2.get("recovery.numQuarantined", 0) == 0
+    assert m2.get("recovery.numCheckpointsWritten", 0) >= 1
+
+
+# ==========================================================================
+# Quarantine: corrupt / stale / conf-mismatch checkpoints
+# ==========================================================================
+def _flip_byte(path, offset=10):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_quarantine_on_flipped_frame_byte(tmp_path):
+    sess = srt.Session(_conf(tmp_path))
+    want = _norm(_query(sess).collect())
+    d = _exchange_dirs(tmp_path)[0]
+    frame = sorted(f for f in os.listdir(d) if f.endswith(".srtb"))[0]
+    _flip_byte(os.path.join(d, frame))
+
+    sess2 = srt.Session(_conf(tmp_path))
+    got = _batch_rows(sess2.resume(_query(sess2).plan))
+    assert got == want  # never a wrong answer
+    m2 = sess2.last_metrics
+    assert m2.get("recovery.numQuarantined", 0) >= 1, m2
+    ev = _events(sess2, "checkpoint_quarantine")
+    assert ev and "TpuPayloadCorruption" in ev[0]["reason"]
+    # renamed aside, and the fresh run re-checkpointed in its place
+    qd = os.path.dirname(d)
+    assert any(n.startswith("quarantine-") for n in os.listdir(qd))
+
+
+def test_quarantine_on_stale_plan_fingerprint(tmp_path):
+    sess = srt.Session(_conf(tmp_path))
+    want = _norm(_query(sess).collect())
+    d = _exchange_dirs(tmp_path)[0]
+    mpath = os.path.join(d, "manifest.json")
+    with open(mpath) as f:
+        m = json.load(f)
+    m["plan_fingerprint"] = "0" * 24  # a different plan's checkpoint
+    with open(mpath, "w") as f:
+        json.dump(m, f)
+
+    sess2 = srt.Session(_conf(tmp_path))
+    got = _batch_rows(sess2.resume(_query(sess2).plan))
+    assert got == want
+    ev = _events(sess2, "checkpoint_quarantine")
+    assert ev and "stale plan fingerprint" in ev[0]["reason"]
+
+
+def test_quarantine_on_changed_result_conf(tmp_path):
+    sess = srt.Session(_conf(tmp_path))
+    want_default = _norm(_query(sess).collect())
+    assert sess.last_metrics.get("recovery.numCheckpointsWritten", 0)
+    # flip a result-affecting key: the checkpoint's conf snapshot no
+    # longer matches, so it must NOT be resumed
+    from spark_rapids_tpu.config import TpuConf
+
+    default = TpuConf({}).get_key(
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled")
+    changed = _conf(tmp_path, **{
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": not default})
+    sess2 = srt.Session(changed)
+    got = _batch_rows(sess2.resume(_query(sess2).plan))
+    assert got == want_default  # float agg still matches at 1e-9 here
+    m2 = sess2.last_metrics
+    assert m2.get("recovery.numStagesResumed", 0) == 0, m2
+    assert m2.get("recovery.numQuarantined", 0) >= 1, m2
+    ev = _events(sess2, "checkpoint_quarantine")
+    assert ev and "conf changed" in ev[0]["reason"]
+
+
+def test_changed_input_data_changes_query_fingerprint(tmp_path):
+    """Same plan SHAPE over different data must not fingerprint-match —
+    resume would serve the wrong rows."""
+    sess = srt.Session(_conf(tmp_path))
+    df = sess.create_dataframe({"k": [1, 2, 1, 2], "v": [1, 2, 3, 4]},
+                               n_partitions=2)
+    df.group_by("k").agg(F.sum("v").alias("s")).collect()
+    fps = set(os.listdir(tmp_path))
+    sess2 = srt.Session(_conf(tmp_path))
+    df2 = sess2.create_dataframe({"k": [1, 2, 1, 2], "v": [9, 8, 7, 6]},
+                                 n_partitions=2)
+    rows = _norm(
+        df2.group_by("k").agg(F.sum("v").alias("s")).collect())
+    assert rows == _norm([(1, 16), (2, 14)])
+    assert sess2.last_metrics.get("recovery.numStagesResumed", 0) == 0
+    assert set(os.listdir(tmp_path)) - fps  # a NEW query dir appeared
+
+
+# ==========================================================================
+# Ladder rungs + retries reuse checkpoints
+# ==========================================================================
+@pytest.mark.fault_injection
+def test_ladder_rungs_resume_from_checkpoints(tmp_path):
+    """``stage_crash`` at exchange.read with no task retries walks the
+    ladder; each rung resumes the exchanges the previous rungs already
+    checkpointed, and the final result is bit-identical to the CPU
+    oracle with ``recovery.numStagesResumed`` > 0."""
+    oracle = _norm(_query(srt.Session(tpu_enabled=False)).collect())
+    conf = _conf(tmp_path, **{
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "stage_crash",
+        "spark.rapids.tpu.fault.injection.site": "exchange.read",
+        "spark.rapids.tpu.fault.injection.skipCount": 0,
+        "spark.rapids.tpu.sql.taskRetries": 0,
+    })
+    sess = srt.Session(conf)
+    got = _norm(_query(sess).collect())
+    assert got == oracle
+    m = sess.last_metrics
+    assert m.get("recovery.numStagesResumed", 0) >= 1, m
+    assert m.get("fault.degradeLevel", 0) >= 1, m
+
+
+@pytest.mark.fault_injection
+@pytest.mark.parametrize("qnum", [1, 3, 5, 6, 16])
+def test_tpch_ladder_under_crash_injection_reuses_checkpoints(
+        qnum, tmp_path):
+    """The acceptance drill on real queries: TPC-H under stage_crash
+    injection at the exchange read with no task retries — the ladder
+    climbs, later rungs reuse the checkpoints earlier rungs committed,
+    and the answer matches the CPU oracle bit-for-bit."""
+    from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+    from spark_rapids_tpu.testing.asserts import assert_rows_equal
+
+    def _run(sess):
+        tables = tpch_datagen.dataframes(sess, sf=0.0007, seed=7)
+        return tpch.QUERIES[qnum](tables).collect()
+
+    oracle = _run(srt.Session(tpu_enabled=False))
+    conf = _conf(tmp_path, **{
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "stage_crash",
+        "spark.rapids.tpu.fault.injection.site": "exchange.read",
+        "spark.rapids.tpu.fault.injection.skipCount": 0,
+        "spark.rapids.tpu.sql.taskRetries": 0,
+    })
+    sess = srt.Session(conf)
+    got = _run(sess)
+    assert_rows_equal(oracle, got, ignore_order=True,
+                      approximate_float=1e-6)
+    m = sess.last_metrics
+    if m.get("fault.degradeLevel", 0) > 0:
+        # the crash fired AFTER an exchange materialized (read side),
+        # so a checkpoint existed — the next rung must have used it
+        assert m.get("recovery.numStagesResumed", 0) >= 1, (qnum, m)
+    if qnum in (3, 5, 16):  # join queries: the read crash must fire
+        assert m.get("fault.degradeLevel", 0) > 0, (qnum, m)
+
+
+@pytest.mark.fault_injection
+def test_corrupt_injection_with_recovery_stays_bit_identical(tmp_path):
+    """A corruption drill on the exchange write path composes with
+    checkpointing: lineage recompute + ladder still produce the
+    injection-free answer."""
+    clean = _norm(_query(srt.Session(dict(
+        FAST, **{"spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+                 "spark.rapids.tpu.sql.taskRetries": 3}))).collect())
+    conf = _conf(tmp_path, **{
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "corrupt",
+        "spark.rapids.tpu.fault.injection.site": "exchange.write",
+        "spark.rapids.tpu.sql.taskRetries": 3,
+    })
+    sess = srt.Session(conf)
+    got = _norm(_query(sess).collect())
+    assert got == clean
+    oracle = _norm(_query(srt.Session(tpu_enabled=False)).collect())
+    assert got == oracle
+
+
+# ==========================================================================
+# Disk-exhaustion robustness
+# ==========================================================================
+def test_enospc_on_checkpoint_write_disables_gracefully(
+        tmp_path, monkeypatch):
+    from spark_rapids_tpu.utils import fsio
+
+    def _boom(path, data):
+        raise OSError(errno.ENOSPC, "No space left on device", path)
+
+    monkeypatch.setattr(fsio, "atomic_write_bytes", _boom)
+    sess = srt.Session(_conf(tmp_path))
+    want = _norm(_query(srt.Session(tpu_enabled=False)).collect())
+    got = _norm(_query(sess).collect())  # query must still succeed
+    assert got == want
+    m = sess.last_metrics
+    assert m.get("recovery.numCheckpointsWritten", 0) == 0, m
+    ev = _events(sess, "checkpoint_disabled")
+    assert ev, "checkpoint_disabled event missing"
+    assert "space" in ev[0]["reason"] or "OSError" in ev[0]["reason"]
+    # nothing half-written became a valid checkpoint
+    for d in _exchange_dirs(tmp_path):
+        assert not os.path.isfile(os.path.join(d, "manifest.json"))
+
+
+def test_enospc_on_spill_write_is_typed_retryable_fault(monkeypatch):
+    from spark_rapids_tpu.data.column import HostBatch, host_to_device
+    from spark_rapids_tpu.memory.spill import SpillFramework, StorageTier
+    from spark_rapids_tpu.utils import fsio
+
+    fw = SpillFramework(host_limit_bytes=1)  # host tier always over
+
+    def _boom(path, data):
+        raise OSError(errno.ENOSPC, "No space left on device", path)
+
+    monkeypatch.setattr(fsio, "atomic_write_bytes", _boom)
+    bid = fw.add_batch(host_to_device(HostBatch.from_pydict(
+        {"x": list(range(64))})))
+    with pytest.raises(TpuStorageExhausted) as ei:
+        fw.spill_device_to_target(0)
+    assert isinstance(ei.value, TpuFaultError)  # the ladder can catch
+    assert ei.value.site == "spill.write.disk"
+    # the victim survived intact on the host tier and is re-queued
+    buf = fw.catalog.get(bid)
+    assert buf.tier == StorageTier.HOST
+    monkeypatch.undo()
+    hb = fw.acquire_batch(bid)
+    assert hb is not None
+    fw.release_batch(bid)
+    fw.remove_batch(bid)
+
+
+def test_spill_to_disk_is_atomic_no_partial_file(tmp_path, monkeypatch):
+    """A failure at the rename step of the atomic write must leave NO
+    ``.srtb`` (and no orphan temp) behind — a partial frame must never
+    be readable later."""
+    from spark_rapids_tpu.data.column import HostBatch, host_to_device
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    fw = SpillFramework(host_limit_bytes=1, spill_dir=str(tmp_path))
+
+    def _boom_replace(src, dst):
+        raise OSError(errno.ENOSPC, "No space left on device", dst)
+
+    bid = fw.add_batch(host_to_device(HostBatch.from_pydict(
+        {"x": list(range(64))})))
+    monkeypatch.setattr(os, "replace", _boom_replace)
+    try:
+        with pytest.raises(TpuStorageExhausted):
+            fw.spill_device_to_target(0)
+    finally:
+        monkeypatch.undo()
+    left = os.listdir(tmp_path)
+    assert not [f for f in left if f.endswith(".srtb")], left
+    assert not [f for f in left if f.startswith(".srt-tmp-")], left
+    fw.remove_batch(bid)
+
+
+# ==========================================================================
+# Unified attempt budget
+# ==========================================================================
+@pytest.mark.fault_injection
+def test_attempt_budget_exhausted_one_terminal_event():
+    conf = dict(FAST, **{
+        "spark.rapids.tpu.fault.injection.mode": "always",
+        "spark.rapids.tpu.fault.injection.type": "stage_crash",
+        "spark.rapids.tpu.fault.injection.site": "exchange.read",
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+        "spark.rapids.tpu.sql.taskRetries": 6,
+        "spark.rapids.tpu.fault.maxTotalAttempts": 2,
+        "spark.rapids.tpu.telemetry.enabled": True,
+    })
+    sess = srt.Session(conf)
+    with pytest.raises(AttemptBudgetExhausted) as ei:
+        _query(sess).collect()
+    assert len(ei.value.ledger) == 3  # charges 1,2 ok; 3 crossed
+    assert all(a["kind"] for a in ei.value.ledger)
+    ev = _events(sess, "attempt_budget_exhausted")
+    assert len(ev) == 1, ev  # ONE terminal event, full ledger attached
+    assert ev[0]["limit"] == 2
+    assert len(ev[0]["ledger"]) == 3
+    # the budget disarmed on the way out (try/finally at query entry)
+    from spark_rapids_tpu.fault.budget import GLOBAL as _g
+    assert not _g.armed()
+
+
+@pytest.mark.fault_injection
+def test_budget_not_exhausted_within_limit():
+    conf = dict(FAST, **{
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "stage_crash",
+        "spark.rapids.tpu.fault.injection.site": "exchange.read",
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+        "spark.rapids.tpu.sql.taskRetries": 3,
+        "spark.rapids.tpu.fault.maxTotalAttempts": 64,
+    })
+    sess = srt.Session(conf)
+    got = _norm(_query(sess).collect())
+    oracle = _norm(_query(srt.Session(tpu_enabled=False)).collect())
+    assert got == oracle
+    assert sess.last_metrics.get("fault.totalAttempts", 0) >= 1
+
+
+def test_budget_disabled_with_zero_limit():
+    from spark_rapids_tpu.fault.budget import AttemptBudget
+
+    b = AttemptBudget()
+    owned = b.begin(0)
+    assert owned
+    for _ in range(100):
+        b.charge("task_retry", site="x")  # never raises at limit 0
+    assert b.count() == 0
+    b.end(owned)
+
+
+def test_budget_nested_begin_is_not_owner():
+    from spark_rapids_tpu.fault.budget import AttemptBudget
+
+    b = AttemptBudget()
+    outer = b.begin(5)
+    inner = b.begin(99)
+    assert outer and not inner
+    b.charge("stage_retry", site="nested")
+    b.end(inner)  # non-owner end is a no-op
+    assert b.armed() and b.count() == 1
+    b.end(outer)
+    assert not b.armed()
+
+
+# ==========================================================================
+# Hygiene: close(), sweeps, TTL, LRU cap
+# ==========================================================================
+def test_session_close_sweeps_orphans_and_expired_checkpoints(tmp_path):
+    root = tmp_path / "rec"
+    sess = srt.Session(_conf(
+        root, **{"spark.rapids.tpu.recovery.ttlSeconds": 3600}))
+    _query(sess).collect()
+    live = _exchange_dirs(root)
+    assert live
+    # plant crash debris: orphan temp files + an expired query dir
+    stale = root / "deadbeefdeadbeefdeadbeef" / "ex"
+    os.makedirs(stale)
+    (stale / "p0-b0.srtb").write_bytes(b"x" * 8)
+    os.utime(stale.parent, (1, 1))  # ancient
+    tmp_file = root / ".srt-tmp-orphan.tmp"
+    tmp_file.write_bytes(b"partial")
+    spill_dir = sess.spill_framework.spill_dir
+    orphan = os.path.join(spill_dir, "buffer-999999.srtb")
+    with open(orphan, "wb") as f:
+        f.write(b"o" * 16)
+    sess.close()
+    assert not tmp_file.exists()
+    assert not stale.parent.exists()
+    assert not os.path.exists(orphan)
+    # live (non-expired) checkpoints survive close
+    assert all(os.path.isdir(d) for d in live)
+    # close is idempotent and the session stays usable
+    sess.close()
+    assert _query(sess).collect()
+
+
+def test_max_bytes_lru_cap_evicts_oldest(tmp_path):
+    from spark_rapids_tpu.recovery import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path))
+    for i, mtime in [(0, 10), (1, 50), (2, 100)]:
+        d = tmp_path / f"q{i}" / "ex"
+        os.makedirs(d)
+        (d / "p0-b0.srtb").write_bytes(b"x" * 1000)
+        os.utime(tmp_path / f"q{i}", (mtime, mtime))
+    removed = store.sweep(ttl_seconds=0, max_bytes=1500)
+    assert removed["removedQueryDirs"] == 2
+    assert not (tmp_path / "q0").exists()  # oldest evicted first
+    assert not (tmp_path / "q1").exists()
+    assert (tmp_path / "q2").exists()
+
+
+def test_scheduler_shutdown_sweeps_storage(tmp_path):
+    root = tmp_path / "rec"
+    sess = srt.Session(_conf(root))
+    h = sess.submit(_query(sess))
+    h.result()
+    tmp_file = root / ".srt-tmp-orphan.tmp"
+    os.makedirs(root, exist_ok=True)
+    tmp_file.write_bytes(b"partial")
+    sess.shutdown_scheduler()
+    assert not tmp_file.exists()
+
+
+# ==========================================================================
+# SIGKILL crash drill: checkpoint, die, resume in a fresh process
+# ==========================================================================
+_CHILD = textwrap.dedent("""\
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    sys.path.insert(0, {repo!r})
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+
+    mode = sys.argv[1]       # "crash" | "resume" | "baseline"
+    qnum = int(sys.argv[2])
+    root = sys.argv[3]
+    conf = {{
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+        "spark.rapids.tpu.recovery.enabled": mode != "baseline",
+        "spark.rapids.tpu.recovery.dir": root,
+        "spark.rapids.tpu.telemetry.enabled": True,
+    }}
+    if mode == "crash":
+        conf["spark.rapids.tpu.recovery.killAfterCheckpoints"] = 1
+    sess = srt.Session(conf)
+    tables = tpch_datagen.dataframes(sess, sf=0.0007, seed=7)
+    df = tpch.QUERIES[qnum](tables)
+    if mode == "resume":
+        hb = sess.resume(df.plan)
+        rows = list(zip(*[c.to_pylist() for c in hb.columns]))
+    else:
+        rows = df.collect()
+    norm = sorted((tuple(round(v, 9) if isinstance(v, float) else v
+                         for v in r) for r in rows), key=repr)
+    out = {{"rows": repr(norm),
+            "resumed": sess.last_metrics.get(
+                "recovery.numStagesResumed", 0)}}
+    print("RESULT:" + json.dumps(out))
+""")
+
+
+def _run_child(mode, qnum, root):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=repo),
+         mode, str(qnum), str(root)],
+        capture_output=True, text=True, timeout=300)
+
+
+def _child_result(proc):
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT:"):
+            return json.loads(line[len("RESULT:"):])
+    raise AssertionError(
+        f"child produced no result:\n{proc.stdout}\n{proc.stderr}")
+
+
+@pytest.mark.parametrize("qnum", [
+    3, pytest.param(5, marks=pytest.mark.slow)])
+def test_sigkill_after_checkpoint_then_resume_fresh_process(
+        qnum, tmp_path):
+    """The crash drill of the issue: run TPC-H q3/q5 with
+    ``recovery.killAfterCheckpoints=1`` (SIGKILL right after the first
+    checkpoint commits), then resume in a FRESH process — bit-identical
+    rows with at least one stage served from checkpoints."""
+    baseline = _run_child("baseline", qnum, tmp_path)
+    assert baseline.returncode == 0, baseline.stderr
+    want = _child_result(baseline)["rows"]
+
+    crashed = _run_child("crash", qnum, tmp_path)
+    assert crashed.returncode == -9, (  # died by SIGKILL, mid-query
+        crashed.returncode, crashed.stdout, crashed.stderr)
+    assert _exchange_dirs(tmp_path), "no checkpoint survived the kill"
+
+    resumed = _run_child("resume", qnum, tmp_path)
+    assert resumed.returncode == 0, resumed.stderr
+    got = _child_result(resumed)
+    assert got["rows"] == want
+    assert got["resumed"] >= 1, got
