@@ -66,6 +66,11 @@ QUERY_TABLES = {
     16: ["part", "partsupp", "supplier"],
 }
 ITERS = 3
+#: wall-clock budget: ``--budget-s`` on the CLI (exported to the child
+#: via SRT_BENCH_BUDGET_S) or the env var directly.  Past the budget,
+#: remaining queries are marked ``"skipped": "budget"`` and the partial
+#: summary still lands atomically (BENCH_r03 died at rc 124 with no
+#: artifact at all — never again).
 BUDGET_S = float(os.environ.get("SRT_BENCH_BUDGET_S", "270"))
 PROBE_TIMEOUT_S = float(os.environ.get("SRT_BENCH_PROBE_TIMEOUT_S", "60"))
 _T0 = time.perf_counter()
@@ -644,6 +649,19 @@ def _persist_tpu_artifact(summary, path=None) -> None:
     _atomic_write_json(path, rec)
 
 
+def _persist_last_summary(summary) -> None:
+    """Every round's summary (complete, budget-truncated, or the
+    orchestrator's wedge-synthesized one) lands atomically in
+    BENCH_LAST.json — a timeout or kill can truncate the run but never
+    the artifact."""
+    try:
+        _persist_tpu_artifact(summary, path=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_LAST.json"))
+    except OSError:
+        pass
+
+
 def main():
     """Orchestrator: probe with backoff, then run the measurement child
     pinned to the probed platform (see module docstring)."""
@@ -714,11 +732,16 @@ def main():
             p = obj.get("progress", "")
             if p.startswith("q") and "tpu_s" in obj:
                 per[p.split(".")[0]] = obj
-        _emit({"metric": "tpch_suite_throughput", "value": None,
-               "unit": "GB/s", "vs_baseline": None,
-               "platform": child_platform + "-wedged-midrun",
-               "per_query": per, "rc": proc.returncode,
-               "elapsed_s": round(time.perf_counter() - _T0, 1)})
+        synth = {"metric": "tpch_suite_throughput", "value": None,
+                 "unit": "GB/s", "vs_baseline": None,
+                 "platform": child_platform + "-wedged-midrun",
+                 "per_query": per, "rc": proc.returncode,
+                 "skipped": [f"q{qn}" for qn in sorted(QUERY_TABLES)
+                             if f"q{qn}" not in per],
+                 "budget_s": BUDGET_S,
+                 "elapsed_s": round(time.perf_counter() - _T0, 1)}
+        _emit(synth)
+        _persist_last_summary(synth)
     return 0
 
 
@@ -782,7 +805,8 @@ def child_main(platform):
             # budget exhausted: keep the partial suite instead of
             # blowing the driver's timeout and reporting nothing
             skipped.append(f"q{qn}")
-            _emit({"progress": f"q{qn}", "skipped": True,
+            per_query[f"q{qn}"] = {"skipped": "budget"}
+            _emit({"progress": f"q{qn}", "skipped": "budget",
                    "elapsed_s": round(time.perf_counter() - _T0, 1)})
             continue
         qbytes = sum(sizes[t] for t in tables)
@@ -898,7 +922,12 @@ def child_main(platform):
         if tpcxbb_mini is not None:
             _emit({"progress": "tpcxbb_mini", **tpcxbb_mini})
     remaining = _deadline() - time.perf_counter()
-    q1p = _q1_pipeline_mrows() if remaining > 15 and not wedged else None
+    q1p = None
+    if remaining > 15 and not wedged:
+        try:
+            q1p = _q1_pipeline_mrows()
+        except Exception as e:  # noqa: BLE001 - never lose the summary
+            q1p = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     summary = {
         "metric": "tpch_suite_throughput",
@@ -927,7 +956,27 @@ def child_main(platform):
         except OSError:
             pass
     _emit(summary)
+    _persist_last_summary(summary)
+
+
+def _parse_args(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="TPC-H suite bench (see module docstring)")
+    ap.add_argument(
+        "--budget-s", type=float, default=None, metavar="SECONDS",
+        help="wall-clock budget; past it remaining queries are "
+             "skipped with a 'budget' marker and the partial summary "
+             "is still written atomically (default: "
+             "SRT_BENCH_BUDGET_S or 270)")
+    return ap.parse_args(argv)
 
 
 if __name__ == "__main__":
+    _args = _parse_args(sys.argv[1:])
+    if _args.budget_s is not None:
+        BUDGET_S = _args.budget_s
+        # the orchestrator's measurement child re-reads it from the env
+        os.environ["SRT_BENCH_BUDGET_S"] = str(_args.budget_s)
     sys.exit(main())

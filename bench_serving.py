@@ -1,0 +1,363 @@
+"""Closed-loop multi-tenant serving stress bench (ISSUE 11 tentpole).
+
+Drives 100+ concurrent mixed-priority TPC-H submissions across three
+tenants through ``Session.submit`` and reports what a serving operator
+actually cares about:
+
+* per-tier p50/p99 end-to-end latency (submit -> terminal status),
+* shed rate (``TpuOverloaded`` with its ``retry_after_ms`` hint, plus
+  ``QueryRejected`` queue_full/queue_timeout rejections),
+* preemption count (checkpoint-backed eviction of low-tier victims),
+* fairness — Jain's index over per-tenant weighted service,
+* correctness — every completed result bit-identical to a clean serial
+  oracle, including under the corrupt/OOM/stage_crash injection suite,
+* hygiene — zero leaked device bytes / reservations / scheduler
+  threads after shutdown.
+
+Tenancy model (the 3-tier shape of the ISSUE overload drill):
+
+===========  ======  ========  ==========================
+tenant       weight  priority  overload behavior
+===========  ======  ========  ==========================
+``gold``     4       5         never shed, preempts lower tiers
+``silver``   2       2         keeps fair share, not shed
+``bronze``   1       0         shed while overloaded
+===========  ======  ========  ==========================
+
+Usage::
+
+    python bench_serving.py                      # 120 subs, no faults
+    python bench_serving.py --inject all         # + the 3 fault rounds
+    python bench_serving.py --submissions 200 --out SERVING_r02.json
+
+The artifact (default ``SERVING_r01.json``) is written atomically —
+a kill mid-run never leaves a truncated JSON.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+QUERIES = [1, 3, 5, 6, 16]
+TENANTS = {
+    "gold": {"weight": 4.0, "priority": 5},
+    "silver": {"weight": 2.0, "priority": 2},
+    "bronze": {"weight": 1.0, "priority": 0},
+}
+#: submission pattern: gold-heavy, interleaved (2 gold : 2 silver :
+#: 2 bronze per 6 arrivals keeps every tier under contention)
+PATTERN = ["gold", "silver", "bronze", "gold", "bronze", "silver"]
+
+#: injection rounds run mode=random (seeded, p=0.25 per matching
+#: checkpoint, auto-suppressed while a recovery is in flight) so faults
+#: keep firing THROUGHOUT the concurrent phase — mode=nth disarms after
+#: one shot, which the warm-up collects would consume before a single
+#: serving submission lands
+INJECT_CONFS = {
+    "none": {},
+    "corrupt": {"spark.rapids.tpu.fault.injection.mode": "random",
+                "spark.rapids.tpu.fault.injection.seed": 11,
+                "spark.rapids.tpu.fault.injection.type": "corrupt",
+                "spark.rapids.tpu.fault.injection.site": "exchange.write"},
+    "oom": {"spark.rapids.tpu.fault.injection.mode": "random",
+            "spark.rapids.tpu.fault.injection.seed": 13,
+            "spark.rapids.tpu.fault.injection.type": "oom",
+            "spark.rapids.tpu.fault.injection.site": "exchange.write"},
+    "stage_crash": {"spark.rapids.tpu.fault.injection.mode": "random",
+                    "spark.rapids.tpu.fault.injection.seed": 17,
+                    "spark.rapids.tpu.fault.injection.type": "stage_crash",
+                    "spark.rapids.tpu.fault.injection.site": "exchange.read"},
+}
+
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def _norm(rows):
+    return sorted(
+        (tuple((None if v is None else
+                (round(v, 9) if isinstance(v, float) else v))
+               for v in r) for r in rows),
+        key=repr)
+
+
+def _pct(samples, q):
+    if not samples:
+        return None
+    s = sorted(samples)
+    return round(s[min(len(s) - 1, int(q * len(s)))], 1)
+
+
+def _jain(xs):
+    xs = [x for x in xs if x is not None]
+    if not xs or not any(xs):
+        return None
+    return round(sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs)), 4)
+
+
+def _serving_conf(sf, inject, recovery_dir):
+    conf = {
+        "spark.rapids.tpu.telemetry.enabled": True,
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+        "spark.rapids.tpu.sql.taskRetries": 3,
+        "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+        "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+        "spark.rapids.tpu.scheduler.maxConcurrent": 4,
+        "spark.rapids.tpu.scheduler.maxQueued": 48,
+        "spark.rapids.tpu.scheduler.queueTimeoutMs": 120_000,
+        "spark.rapids.tpu.scheduler.queryTimeoutMs": 120_000,
+        "spark.rapids.tpu.scheduler.priorityAgingMs": 200,
+        "spark.rapids.tpu.scheduler.overload.queueWaitMs": 400,
+        "spark.rapids.tpu.scheduler.overload.shedBelowPriority": 2,
+        "spark.rapids.tpu.scheduler.overload.retryAfterMs": 250,
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.recovery.dir": recovery_dir,
+    }
+    for name, t in TENANTS.items():
+        conf[f"spark.rapids.tpu.scheduler.tenant.{name}.weight"] = \
+            t["weight"]
+    conf.update(INJECT_CONFS[inject])
+    return conf
+
+
+def _oracles(sf):
+    """Clean serial per-query answers from an injection-free session
+    (the bit-identical bar every concurrent result must clear)."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+
+    sess = srt.Session({"spark.rapids.tpu.sql.broadcastSizeThreshold": 0})
+    tables = tpch_datagen.dataframes(sess, sf=sf, seed=42)
+    out = {}
+    for qn in QUERIES:
+        out[qn] = _norm(tpch.QUERIES[qn](tables).collect())
+    sess.close()
+    return out
+
+
+def run_round(inject, n_submissions, sf, oracles, deadline):
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+    from spark_rapids_tpu.scheduler import QueryRejected, TpuOverloaded
+
+    recovery_dir = tempfile.mkdtemp(prefix=f"serving-{inject}-")
+    sess = srt.Session(_serving_conf(sf, inject, recovery_dir))
+    tables = tpch_datagen.dataframes(sess, sf=sf, seed=42)
+    plans = {qn: tpch.QUERIES[qn](tables) for qn in QUERIES}
+    # warm the kernel cache once so the measured latencies are serving
+    # latencies, not compile walls
+    t_warm = time.perf_counter()
+    for qn in QUERIES:
+        plans[qn].collect()
+    warm_s = time.perf_counter() - t_warm
+
+    inflight = []  # (handle, tenant, qn, t_submit)
+    done_at = {}  # query_id -> t_done (first seen by the poller)
+    shed = {t: 0 for t in TENANTS}
+    rejected = {t: 0 for t in TENANTS}
+    retry_hints = []
+    stop_poll = threading.Event()
+
+    def _poll():
+        while not stop_poll.is_set():
+            now = time.perf_counter()
+            for h, _t, _q, _ts in inflight:
+                if h.query_id not in done_at and h.done():
+                    done_at[h.query_id] = now
+            time.sleep(0.002)
+
+    poller = threading.Thread(target=_poll, daemon=True)
+    poller.start()
+
+    t0 = time.perf_counter()
+    for i in range(n_submissions):
+        tenant = PATTERN[i % len(PATTERN)]
+        qn = QUERIES[i % len(QUERIES)]
+        try:
+            h = sess.submit(plans[qn], tenant=tenant,
+                            priority=TENANTS[tenant]["priority"])
+            inflight.append((h, tenant, qn, time.perf_counter()))
+        except TpuOverloaded as e:
+            shed[tenant] += 1
+            retry_hints.append(e.retry_after_ms)
+        except QueryRejected:
+            rejected[tenant] += 1
+        time.sleep(0.002)  # ~500 arrivals/s open-loop pressure
+
+    # drain: every admitted query must reach a terminal state
+    for h, _t, _q, _ts in inflight:
+        try:
+            h.result(timeout=max(5.0, deadline - time.perf_counter()))
+        except Exception:  # noqa: BLE001 — failures are tallied below
+            pass
+    stop_poll.set()
+    poller.join(timeout=5)
+    wall_s = time.perf_counter() - t0
+
+    lat = {t: [] for t in TENANTS}
+    completed = {t: 0 for t in TENANTS}
+    failed = {t: 0 for t in TENANTS}
+    mismatches = 0
+    preemptions = 0
+    for h, tenant, qn, t_sub in inflight:
+        preemptions += h.preemptions
+        if h.status() == "finished":
+            completed[tenant] += 1
+            t_done = done_at.get(h.query_id, time.perf_counter())
+            lat[tenant].append((t_done - t_sub) * 1000.0)
+            try:
+                if _norm(h.result(timeout=1).to_rows()) != oracles[qn]:
+                    mismatches += 1
+            except Exception:  # noqa: BLE001
+                mismatches += 1
+        else:
+            failed[tenant] += 1
+
+    qos = sess.scheduler.qos_metrics()
+    overload_history = list(sess.scheduler.overload.history)
+    dispatch_log = list(sess.scheduler.qos.dispatch_log)
+    # proof the drill drilled: checkpoint/fire counters from the live
+    # injector (0 fired in an injection round would mean a dead site)
+    from spark_rapids_tpu.fault.injector import get_fault_injector
+
+    inj = get_fault_injector()
+    faults = {"checkpoints_seen": inj.checkpoints_seen if inj else 0,
+              "injections_fired": inj.injections_fired if inj else 0}
+    sess.shutdown_scheduler()
+
+    # hygiene: the zero-leak and thread-leak contracts, post-shutdown.
+    # The plan/table handles pin their upload caches — drop them first
+    # so device_bytes reflects scheduler leakage, not live caches.
+    import gc
+
+    del plans, tables
+    dm = sess.device_manager
+    catalog = sess.shuffle_catalog
+    sess.close()
+    gc.collect()
+    leaks = {
+        "device_bytes": int(dm.allocated_bytes) if dm else 0,
+        "reserved_bytes": int(dm.reserved_bytes) if dm else 0,
+        "shuffle_slots": int(catalog.slot_count()) if catalog else 0,
+        "scheduler_threads": [
+            t.name for t in threading.enumerate()
+            if t.name.startswith(("query-scheduler", "query-worker"))],
+    }
+
+    per_tier = {}
+    for t in TENANTS:
+        per_tier[t] = {
+            "submitted": PATTERN[:n_submissions % len(PATTERN)].count(t)
+            + (n_submissions // len(PATTERN)) * PATTERN.count(t),
+            "completed": completed[t],
+            "failed": failed[t],
+            "shed": shed[t],
+            "rejected": rejected[t],
+            "p50_ms": _pct(lat[t], 0.50),
+            "p99_ms": _pct(lat[t], 0.99),
+        }
+    # Fairness over the CONTENDED window: in a finite batch everything
+    # eventually completes, so completed/weight converges to demand,
+    # not to fair-share service.  The first half of the dispatch log —
+    # while every tenant still has backlog — is where weighted fair
+    # queuing is observable: dispatches/weight should be ~equal there
+    # (Jain -> 1.0 when service tracks weights).
+    window = dispatch_log[:max(1, len(dispatch_log) // 2)]
+    fairness = _jain([
+        sum(1 for tn, _q in window if tn == t) / TENANTS[t]["weight"]
+        for t in TENANTS
+        if any(tn == t for tn, _q in dispatch_log) or shed[t]])
+    fairness_completed = _jain([completed[t] / TENANTS[t]["weight"]
+                                for t in TENANTS
+                                if completed[t] or shed[t]])
+    total_shed = sum(shed.values())
+    round_out = {
+        "inject": inject,
+        "submissions": n_submissions,
+        "admitted": len(inflight),
+        "wall_s": round(wall_s, 2),
+        "warm_s": round(warm_s, 2),
+        "per_tier": per_tier,
+        "shed_rate": round(total_shed / n_submissions, 4),
+        "retry_after_ms_p50": _pct(retry_hints, 0.5),
+        "preemptions": preemptions,
+        "tenant_preempted": {
+            t: qos.get(f"scheduler.tenant.{t}.preempted", 0)
+            for t in TENANTS},
+        "jain_fairness": fairness,
+        "jain_completed_per_weight": fairness_completed,
+        "mismatches": mismatches,
+        "faults": faults,
+        "overload_transitions": overload_history,
+        "leaks": leaks,
+    }
+    _emit({"progress": f"round.{inject}", **{
+        k: round_out[k] for k in ("wall_s", "admitted", "shed_rate",
+                                  "preemptions", "jain_fairness",
+                                  "mismatches")}})
+    return round_out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--submissions", type=int, default=120,
+                    help="concurrent submissions in the clean round "
+                         "(injection rounds run 1/3 of this)")
+    ap.add_argument("--inject", default="none",
+                    choices=["none", "corrupt", "oom", "stage_crash",
+                             "all"],
+                    help="fault mode; 'all' = clean round + the three "
+                         "injection rounds")
+    ap.add_argument("--sf", type=float, default=0.001,
+                    help="TPC-H scale factor (serving-sized default)")
+    ap.add_argument("--budget-s", type=float, default=600.0,
+                    help="wall-clock budget for the whole run")
+    ap.add_argument("--out", default="SERVING_r01.json")
+    args = ap.parse_args(argv)
+
+    deadline = time.perf_counter() + args.budget_s
+    oracles = _oracles(args.sf)
+    modes = (["none", "corrupt", "oom", "stage_crash"]
+             if args.inject == "all" else [args.inject])
+    rounds = {}
+    for mode in modes:
+        if time.perf_counter() > deadline - 30 and rounds:
+            rounds[mode] = {"skipped": "budget"}
+            _emit({"progress": f"round.{mode}", "skipped": "budget"})
+            continue
+        n = args.submissions if mode == "none" \
+            else max(30, args.submissions // 3)
+        rounds[mode] = run_round(mode, n, args.sf, oracles, deadline)
+
+    ran = [r for r in rounds.values() if "skipped" not in r]
+    summary = {
+        "metric": "serving_stress",
+        "submissions": args.submissions,
+        "sf": args.sf,
+        "tenants": {t: {**TENANTS[t]} for t in TENANTS},
+        "rounds": rounds,
+        "total_mismatches": sum(r["mismatches"] for r in ran),
+        "total_leaked_threads": sum(
+            len(r["leaks"]["scheduler_threads"]) for r in ran),
+        "elapsed_s": round(
+            time.perf_counter() - (deadline - args.budget_s), 1),
+    }
+    from spark_rapids_tpu.utils import fsio
+
+    fsio.atomic_write_json(args.out, summary)
+    _emit(summary)
+    # the bench FAILS on a correctness or hygiene violation — sheds
+    # and preemptions are expected behavior, wrong answers are not
+    ok = (summary["total_mismatches"] == 0
+          and summary["total_leaked_threads"] == 0
+          and all(r["faults"]["injections_fired"] >= 1
+                  for r in ran if r["inject"] != "none"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

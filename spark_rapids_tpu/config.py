@@ -133,10 +133,44 @@ def dump_markdown() -> str:
             continue
         lines.append(f"| `{key}` | {e.default} | {e.doc} |")
     lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC,
-              "", _SCHEDULING_DOC, "", _OBSERVABILITY_DOC, "",
-              _PERF_TUNING_DOC, "", _SHUFFLE_DOC, "", _ADAPTIVE_DOC,
-              "", _RECOVERY_DOC]
+              "", _SCHEDULING_DOC, "", _QOS_DOC, "",
+              _OBSERVABILITY_DOC, "", _PERF_TUNING_DOC, "",
+              _SHUFFLE_DOC, "", _ADAPTIVE_DOC, "", _RECOVERY_DOC]
     return "\n".join(lines)
+
+
+_QOS_DOC = """\
+## Multi-tenant QoS: fair admission, aging, preemption, shedding
+
+The `scheduler.tenant.*` / `scheduler.overload.*` /
+`scheduler.priorityAgingMs` / `scheduler.preemption.*` confs (table
+above) configure the multi-tenant QoS layer
+(`spark_rapids_tpu/scheduler/qos.py`, docs/qos.md):
+
+* **Tenants** — `Session.submit(plan, priority, tenant="name")` routes
+  through per-tenant queues drained by deficit-weighted fair share.
+  Tenant names need no pre-registration: `scheduler.tenant.<name>.
+  {weight,maxConcurrent,hbmFraction}` are read as dynamic keys, falling
+  back to the registered `scheduler.tenant.default.*` entries.
+* **Priority aging** — a queued query's effective priority grows by one
+  per `scheduler.priorityAgingMs` of queue wait, so fixed priorities
+  order dispatch but can never starve a queued query forever.
+* **Checkpoint-backed preemption** — `scheduler.preemption.enabled`
+  lets a strictly higher-priority queued query evict the
+  lowest-priority running victim through the cooperative-cancel
+  zero-leak unwind; the victim is requeued (keeping its aging credit)
+  and, with `recovery.enabled`, resumes from its completed exchange
+  checkpoints (`recovery.numStagesResumed` in the victim's metrics).
+  Each preemption is charged against the victim's
+  `fault.maxTotalAttempts` budget.
+* **Overload detection + load shedding** — the OverloadMonitor tracks
+  queue-wait p95 and arena pressure against
+  `scheduler.overload.{queueWaitMs,hbmFraction}`; while overloaded,
+  submissions below `scheduler.overload.shedBelowPriority` are shed
+  with `TpuOverloaded(retry_after_ms=...)`, and
+  `overload_{enter,exit,shed}` / `preempt_{victim,resume}` telemetry
+  events plus `scheduler.tenant.*` counters make the behavior
+  observable (`QueryScheduler.qos_metrics()`)."""
 
 
 _RECOVERY_DOC = """\
@@ -607,6 +641,77 @@ SCHEDULER_QUERY_TIMEOUT_MS = conf(
     "dispatch: past it the query's CancelToken trips and the query "
     "unwinds cooperatively at its next operator checkpoint with "
     "TpuQueryCancelled (0 disables)").int_conf(0)
+
+# --- multi-tenant QoS: fair admission, aging, preemption, shedding
+# (scheduler/qos.py; reference: admission tiers + fair arbitration in
+# "Accelerating Presto with GPUs") --------------------------------------
+SCHEDULER_PRIORITY_AGING_MS = conf(
+    "spark.rapids.tpu.scheduler.priorityAgingMs").doc(
+    "Priority aging: for every this-many milliseconds a query waits "
+    "in the admission queue its EFFECTIVE priority grows by one, so a "
+    "steady stream of high-priority submissions can delay — but never "
+    "indefinitely starve — an already-queued low-priority query (0 "
+    "disables aging and restores fixed priorities)").int_conf(5000)
+SCHEDULER_PREEMPTION_ENABLED = conf(
+    "spark.rapids.tpu.scheduler.preemption.enabled").doc(
+    "Checkpoint-backed preemption: a strictly higher-priority queued "
+    "query blocked on a run slot or its HBM reservation cooperatively "
+    "cancels the lowest-priority running query (the zero-leak "
+    "CancelToken unwind), requeues it, and on re-admission the "
+    "recovery store (recovery.enabled) resumes the victim from its "
+    "completed exchange checkpoints — bit-identical results, each "
+    "preemption charged against the victim's fault.maxTotalAttempts "
+    "budget").boolean_conf(True)
+SCHEDULER_TENANT_DEFAULT_WEIGHT = conf(
+    "spark.rapids.tpu.scheduler.tenant.default.weight").doc(
+    "Fair-share weight of the default tenant; any "
+    "scheduler.tenant.<name>.weight key (read dynamically, no "
+    "pre-registration) sets another tenant's weight and falls back to "
+    "this one.  Dispatch drains per-tenant queues by deficit-weighted "
+    "fair share: under contention a tenant with twice the weight "
+    "receives twice the dispatch share").double_conf(1.0)
+SCHEDULER_TENANT_DEFAULT_MAX_CONCURRENT = conf(
+    "spark.rapids.tpu.scheduler.tenant.default.maxConcurrent").doc(
+    "Per-tenant cap on concurrently RUNNING queries, 0 = bounded only "
+    "by scheduler.maxConcurrent; scheduler.tenant.<name>.maxConcurrent "
+    "(dynamic key) overrides it per tenant").int_conf(0)
+SCHEDULER_TENANT_DEFAULT_HBM_FRACTION = conf(
+    "spark.rapids.tpu.scheduler.tenant.default.hbmFraction").doc(
+    "Per-tenant HBM reservation fraction charged per dispatched query, "
+    "0 = use scheduler.reservationFraction; "
+    "scheduler.tenant.<name>.hbmFraction (dynamic key) overrides it "
+    "per tenant").double_conf(0.0)
+SCHEDULER_OVERLOAD_QUEUE_WAIT_MS = conf(
+    "spark.rapids.tpu.scheduler.overload.queueWaitMs").doc(
+    "Overload threshold on the p95 queue wait (recent dispatches plus "
+    "queries still waiting): past it the OverloadMonitor declares "
+    "overload and new submissions below "
+    "scheduler.overload.shedBelowPriority are shed with TpuOverloaded "
+    "carrying a retry_after_ms backoff hint (0 disables queue-wait "
+    "overload detection)").int_conf(0)
+SCHEDULER_OVERLOAD_HBM_FRACTION = conf(
+    "spark.rapids.tpu.scheduler.overload.hbmFraction").doc(
+    "Overload threshold on arena pressure (DeviceManager allocated / "
+    "arena bytes): past it the OverloadMonitor declares overload and "
+    "sheds low-tier submissions (0 disables arena-pressure overload "
+    "detection)").double_conf(0.0)
+SCHEDULER_OVERLOAD_SHED_BELOW_PRIORITY = conf(
+    "spark.rapids.tpu.scheduler.overload.shedBelowPriority").doc(
+    "While overloaded, a submit with priority below this value is shed "
+    "with TpuOverloaded (a typed retryable QueryRejected carrying "
+    "retry_after_ms); submissions at or above it are still admitted "
+    "under the normal queue bounds").int_conf(1)
+SCHEDULER_OVERLOAD_RETRY_AFTER_MS = conf(
+    "spark.rapids.tpu.scheduler.overload.retryAfterMs").doc(
+    "Base backoff hint carried by TpuOverloaded.retry_after_ms, scaled "
+    "up with current queue depth — a shed client should not retry "
+    "sooner").int_conf(1000)
+SCHEDULER_OVERLOAD_SAMPLE_MS = conf(
+    "spark.rapids.tpu.scheduler.overload.sampleMs").doc(
+    "OverloadMonitor sampling period, milliseconds: the monitor thread "
+    "re-evaluates queue-wait p95 and arena pressure this often (the "
+    "state is also re-evaluated inline at every submit), emitting "
+    "overload_enter/overload_exit transition events").int_conf(100)
 
 # --- scheduling -----------------------------------------------------------
 CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(

@@ -11,7 +11,8 @@ from .cancel import (CancelToken, TpuQueryCancelled,  # noqa: F401
                      check_cancel)
 
 _LAZY = ("QueryScheduler", "QueryHandle", "QueryRejected",
-         "QueryStatus")
+         "QueryStatus", "TpuOverloaded", "OverloadMonitor",
+         "TenantRegistry", "DEFAULT_TENANT")
 
 
 def __getattr__(name):

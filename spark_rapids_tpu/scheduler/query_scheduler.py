@@ -1,26 +1,45 @@
-"""QueryScheduler — bounded admission, dispatch, deadlines and
-per-query failure isolation for concurrent queries.
+"""QueryScheduler — bounded admission, multi-tenant fair share,
+dispatch, deadlines, preemption and per-query failure isolation for
+concurrent queries.
 
 Reference analogue: the admission/memory-arbitration layer Theseus-
 style accelerator engines put in front of scarce device memory (see
 PAPERS.md) — here built on the existing DeviceManager budget, retry
-framework, degradation ladder and telemetry events.
+framework, degradation ladder and telemetry events, with the
+multi-tenant QoS tier of "Accelerating Presto with GPUs" on top
+(:mod:`.qos`).
 
 Model:
 
-* ``Session.submit(plan)`` -> :class:`QueryHandle` — at most
-  ``scheduler.maxConcurrent`` queries run concurrently (one daemon
-  worker thread each), at most ``scheduler.maxQueued`` wait in the
-  bounded priority queue; a submit past the bound — or a queued query
-  not dispatched within ``scheduler.queueTimeoutMs`` — is shed with
-  :class:`QueryRejected` plus an ``admission_reject`` event.
+* ``Session.submit(plan, priority, tenant=...)`` -> :class:`QueryHandle`
+  — at most ``scheduler.maxConcurrent`` queries run concurrently (one
+  daemon worker thread each); queued queries wait in per-tenant queues
+  drained by deficit-weighted fair share with priority aging
+  (:mod:`.qos`).  A submit past ``scheduler.maxQueued`` — or a queued
+  query not dispatched within ``scheduler.queueTimeoutMs`` — is shed
+  with :class:`QueryRejected` plus an ``admission_reject`` event
+  carrying the queue depth and queue wait.
+* While the :class:`~.qos.OverloadMonitor` declares overload (queue-wait
+  p95 or arena pressure past ``scheduler.overload.*`` thresholds), new
+  submissions below ``scheduler.overload.shedBelowPriority`` are shed
+  with :class:`~.qos.TpuOverloaded` carrying a ``retry_after_ms``
+  backoff hint (``overload_shed`` event).
 * Each dispatched query holds an HBM *reservation* of
-  ``scheduler.reservationFraction`` x the DeviceManager arena for its
-  lifetime (``DeviceManager.try_reserve``): dispatch waits until the
-  reservation fits, so the sum of running reservations never exceeds
-  the arena.  When nothing is running the head query dispatches even
-  if its reservation cannot be charged — forward progress is never
+  ``scheduler.reservationFraction`` (or its tenant's ``hbmFraction``)
+  x the DeviceManager arena for its lifetime
+  (``DeviceManager.try_reserve``): dispatch waits until the reservation
+  fits, so the sum of running reservations never exceeds the arena.
+  When nothing is running the head query dispatches even if its
+  reservation cannot be charged — forward progress is never
   reservation-deadlocked.
+* **Checkpoint-backed preemption** — a strictly higher-priority queued
+  query blocked on a slot or its reservation cooperatively cancels the
+  lowest-priority running victim (the same zero-leak CancelToken
+  unwind as a terminal cancel), requeues it with its aging credit
+  intact, and on re-dispatch the recovery store (``recovery.enabled``)
+  resumes the victim from its completed exchange checkpoints
+  (``preempt_victim`` / ``preempt_resume`` events); every preemption
+  is charged against the victim's ``fault.maxTotalAttempts`` budget.
 * Cancellation is cooperative: ``handle.cancel()`` (or the
   ``scheduler.queryTimeoutMs`` deadline, or an injected ``cancel``
   fault) trips the query's :class:`~.cancel.CancelToken`; every
@@ -36,7 +55,6 @@ Model:
 """
 from __future__ import annotations
 
-import heapq
 import itertools
 import logging
 import threading
@@ -45,6 +63,8 @@ import weakref
 from typing import Dict, List, Optional
 
 from .cancel import CancelToken, TpuQueryCancelled
+from .qos import (DEFAULT_TENANT, OverloadMonitor,  # noqa: F401
+                  QueryRejected, TenantRegistry, TpuOverloaded)
 
 log = logging.getLogger(__name__)
 
@@ -63,10 +83,6 @@ def shutdown_all() -> None:
             pass
 
 
-class QueryRejected(RuntimeError):
-    """The scheduler shed this query (queue full or queue timeout)."""
-
-
 class QueryStatus:
     QUEUED = "queued"
     RUNNING = "running"
@@ -76,15 +92,24 @@ class QueryStatus:
     REJECTED = "rejected"
 
 
+#: terminal status -> tenant counter (QUEUED = a preemption requeue)
+_DONE_COUNTER = {QueryStatus.FINISHED: "finished",
+                 QueryStatus.FAILED: "failed",
+                 QueryStatus.CANCELLED: "cancelled",
+                 QueryStatus.REJECTED: "cancelled",
+                 QueryStatus.QUEUED: "preempted"}
+
+
 class QueryHandle:
     """Caller-side handle of one submitted query."""
 
     def __init__(self, scheduler: "QueryScheduler", query_id: int,
-                 plan, priority: int):
+                 plan, priority: int, tenant: str = DEFAULT_TENANT):
         self._scheduler = scheduler
         self.query_id = query_id
         self.plan = plan
         self.priority = priority
+        self.tenant = tenant
         self.token = CancelToken(query_id)
         self._lock = threading.Lock()
         self._done = threading.Event()
@@ -92,6 +117,17 @@ class QueryHandle:
         self._result = None
         self._error: Optional[BaseException] = None
         self._queued_at = time.monotonic()
+        #: first enqueue stamp — survives preemption requeues, so a
+        #: victim keeps its priority-aging credit
+        self._first_queued_at = self._queued_at
+        #: times this query was preempted; charged against the
+        #: fault.maxTotalAttempts budget
+        self.preemptions = 0
+        self._user_cancel = False
+        #: preemptor's query id while an eviction is in flight
+        self._preempted_by: Optional[int] = None
+        #: event rings of earlier, preempted attempts (events())
+        self._prior_events: List[Dict] = []
         #: per-query attribution (the session's last_metrics /
         #: last_profile are last-writer-wins under concurrency)
         self.metrics: Dict = {}
@@ -117,6 +153,7 @@ class QueryHandle:
         """Trip the query's cancel token; a queued query is removed
         immediately, a running one unwinds at its next checkpoint.
         Returns True on the first effective cancel."""
+        self._user_cancel = True  # a preemption requeue must not undo it
         first = self.token.cancel(reason)
         self._scheduler._on_cancel(self, reason)
         return first
@@ -130,11 +167,13 @@ class QueryHandle:
 
     def events(self) -> List[Dict]:
         """This query's telemetry event ring (empty when telemetry was
-        disabled)."""
+        disabled); for a preempted query the rings of its earlier
+        attempts come first, so preempt_victim events stay visible."""
+        out = list(self._prior_events)
         tele = getattr(self._ctx, "telemetry", None)
-        if tele is None or tele.events is None:
-            return []
-        return tele.events.snapshot()
+        if tele is not None and tele.events is not None:
+            out.extend(tele.events.snapshot())
+        return out
 
     # ----- scheduler-side transitions --------------------------------------
     def _mark_running(self) -> None:
@@ -153,16 +192,31 @@ class QueryHandle:
             self._done.set()
             return True
 
+    def _reset_for_requeue(self) -> None:
+        """Preemption requeue: back to QUEUED with a FRESH cancel token
+        (the tripped one is spent) and a fresh queue-timeout clock —
+        but the original first-queued stamp, so the victim keeps its
+        aging credit and re-dispatches ahead of equal-priority
+        newcomers."""
+        with self._lock:
+            self._status = QueryStatus.QUEUED
+        self.token = CancelToken(self.query_id)
+        self._queued_at = time.monotonic()
+
 
 class QueryScheduler:
     """One per Session (created lazily by ``Session.submit``); owns a
-    dispatcher thread plus one daemon worker thread per running
-    query."""
+    dispatcher thread, an overload-monitor thread (when the
+    ``scheduler.overload.*`` thresholds are set), plus one daemon
+    worker thread per running query."""
 
     def __init__(self, session):
         from ..config import (FAULT_DEGRADE_ENABLED,
                               SCHEDULER_MAX_CONCURRENT,
                               SCHEDULER_MAX_QUEUED,
+                              SCHEDULER_OVERLOAD_SHED_BELOW_PRIORITY,
+                              SCHEDULER_PREEMPTION_ENABLED,
+                              SCHEDULER_PRIORITY_AGING_MS,
                               SCHEDULER_QUERY_TIMEOUT_MS,
                               SCHEDULER_QUEUE_TIMEOUT_MS,
                               SCHEDULER_RESERVATION_FRACTION)
@@ -174,6 +228,10 @@ class QueryScheduler:
         self.max_queued = max(0, conf.get(SCHEDULER_MAX_QUEUED))
         self.queue_timeout_ms = conf.get(SCHEDULER_QUEUE_TIMEOUT_MS)
         self.query_timeout_ms = conf.get(SCHEDULER_QUERY_TIMEOUT_MS)
+        self.aging_ms = conf.get(SCHEDULER_PRIORITY_AGING_MS)
+        self.preemption_enabled = conf.get(SCHEDULER_PREEMPTION_ENABLED)
+        self.shed_below_priority = conf.get(
+            SCHEDULER_OVERLOAD_SHED_BELOW_PRIORITY)
         self._dm = session.device_manager
         frac = conf.get(SCHEDULER_RESERVATION_FRACTION)
         self.reservation_bytes = 0
@@ -183,11 +241,15 @@ class QueryScheduler:
         self._degrade_enabled = (self._dm is not None
                                  and conf.get(FAULT_DEGRADE_ENABLED))
         self._cv = threading.Condition()
-        self._heap: List = []  # (-priority, seq, handle)
-        self._seq = itertools.count()
+        self.qos = TenantRegistry(conf)
+        self.overload = OverloadMonitor(conf, self._queue_waits_ms,
+                                        self._arena_pressure)
         self._next_qid = itertools.count(1)
         self._n_active = 0
         self._running: set = set()  # running QueryHandles
+        #: the victim of an in-flight eviction — one at a time, so a
+        #: burst of high-tier arrivals cannot cascade-cancel the world
+        self._preempt_inflight: Optional[QueryHandle] = None
         #: worker-thread ident -> [currently held reservation bytes];
         #: the mutable cell lets AQE shrink a running query's charge
         #: (rebase_reservation) while the worker's finally still
@@ -202,48 +264,92 @@ class QueryScheduler:
             target=tspans.bound(tspans.capture(), self._dispatch_loop),
             daemon=True, name="query-scheduler")
         self._dispatcher.start()
+        self.overload.start()
 
     # ----- submission ------------------------------------------------------
-    def submit(self, plan, priority: int = 0) -> QueryHandle:
+    def submit(self, plan, priority: int = 0,
+               tenant: str = DEFAULT_TENANT) -> QueryHandle:
         from ..telemetry.events import emit_event
 
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("QueryScheduler is shut down")
-            if len(self._heap) >= self.max_queued \
+            self._maybe_shed_overload_locked(priority, tenant)
+            queued = self.qos.queued_count_locked()
+            if queued >= self.max_queued \
                     and self._n_active >= self.max_concurrent:
-                queued, running = len(self._heap), self._n_active
+                now = time.monotonic()
+                oldest = self.qos.earliest_queued_at_locked()
+                head_wait = round((now - oldest) * 1000.0, 1) \
+                    if oldest is not None else 0.0
                 emit_event("admission_reject", source="scheduler",
                            reason="queue_full", queued=queued,
-                           running=running,
+                           running=self._n_active,
+                           queue_depth=queued,
+                           queue_wait_ms=head_wait, tenant=tenant,
                            max_queued=self.max_queued,
                            max_concurrent=self.max_concurrent)
                 raise QueryRejected(
-                    f"scheduler queue full ({running} running / "
+                    f"scheduler queue full ({self._n_active} running / "
                     f"{queued} queued; maxConcurrent="
                     f"{self.max_concurrent}, maxQueued="
                     f"{self.max_queued})")
             handle = QueryHandle(self, next(self._next_qid), plan,
-                                 priority)
-            heapq.heappush(self._heap,
-                           (-priority, next(self._seq), handle))
+                                 priority, tenant)
+            self.qos.enqueue_locked(handle)
             self._cv.notify_all()
         return handle
+
+    def _maybe_shed_overload_locked(self, priority: int,
+                                    tenant: str) -> None:
+        """Load-shedding decision site: while the OverloadMonitor is
+        in overload, a submit below scheduler.overload.shedBelowPriority
+        is shed with TpuOverloaded (typed, retryable, carrying the
+        retry_after_ms backoff hint) plus an overload_shed event —
+        emitted on the submitting thread, where the caller's telemetry
+        binding lives."""
+        from ..telemetry.events import emit_event
+
+        if not self.overload.enabled:
+            return
+        if not self.overload.evaluate() \
+                or priority >= self.shed_below_priority:
+            return
+        depth = self.qos.queued_count_locked()
+        retry_ms = self.overload.retry_after_ms(depth, self.max_queued)
+        self.qos.count_shed_locked(tenant)
+        emit_event("overload_shed", source="scheduler", tenant=tenant,
+                   priority=priority, queue_depth=depth,
+                   retry_after_ms=retry_ms,
+                   queue_wait_p95_ms=round(self.overload.wait_p95(), 1))
+        raise TpuOverloaded(
+            f"scheduler overloaded: priority {priority} submission "
+            f"shed (below shedBelowPriority="
+            f"{self.shed_below_priority}); retry after {retry_ms}ms",
+            retry_after_ms=retry_ms)
 
     # ----- caller-side cancel hook -----------------------------------------
     def _on_cancel(self, handle: QueryHandle, reason: str) -> None:
         """Remove a still-queued handle immediately; a running one
         unwinds cooperatively at its next checkpoint."""
         with self._cv:
-            before = len(self._heap)
-            self._heap = [e for e in self._heap if e[2] is not handle]
-            removed = len(self._heap) != before
+            removed = self.qos.remove_locked(handle)
             if removed:
-                heapq.heapify(self._heap)
                 self._cv.notify_all()
         if removed:
             handle._finish(QueryStatus.CANCELLED,
                            error=TpuQueryCancelled(reason))
+
+    # ----- overload-monitor inputs ------------------------------------------
+    def _queue_waits_ms(self) -> List[float]:
+        with self._cv:
+            return self.qos.queue_waits_ms_locked(time.monotonic())
+
+    def _arena_pressure(self) -> float:
+        dm = self._dm
+        if dm is None or dm.arena_bytes <= 0:
+            return 0.0
+        return dm.allocated_bytes / float(dm.arena_bytes)
 
     # ----- dispatcher ------------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -251,31 +357,42 @@ class QueryScheduler:
 
         while True:
             with self._cv:
-                handle = reservation = None
+                handle = cand = None
+                reservation = 0
                 while handle is None:
                     if self._shutdown:
                         return
-                    self._shed_expired_locked(time.monotonic())
-                    if self._heap \
-                            and self._n_active < self.max_concurrent:
-                        entry = heapq.heappop(self._heap)
-                        cand = entry[2]
-                        if cand._done.is_set():
-                            continue  # cancelled while queued
-                        reservation = self.reservation_bytes
-                        if reservation and not self._dm.try_reserve(
-                                reservation):
-                            if self._n_active == 0:
-                                # forward-progress guarantee: an empty
-                                # machine always runs the head query
-                                reservation = 0
-                            else:
-                                heapq.heappush(self._heap, entry)
-                                self._cv.wait(timeout=0.05)
-                                continue
-                        handle = cand
-                        continue
+                    now = time.monotonic()
+                    self._shed_expired_locked(now)
+                    if self._n_active < self.max_concurrent:
+                        cand = self.qos.pick_locked(now, self.aging_ms)
+                        if cand is not None:
+                            reservation = \
+                                self._reservation_for_locked(cand)
+                            if reservation and not self._dm.try_reserve(
+                                    reservation):
+                                if self._n_active == 0:
+                                    # forward-progress guarantee: an
+                                    # empty machine always runs the
+                                    # head query
+                                    reservation = 0
+                                else:
+                                    self.qos.requeue_front_locked(cand)
+                                    self._maybe_preempt_locked(cand)
+                                    self._cv.wait(timeout=0.05)
+                                    continue
+                            handle = cand
+                            continue
+                    else:
+                        # every slot is busy: a strictly higher-tier
+                        # queued query may still evict a victim
+                        cand = self.qos.peek_locked(now, self.aging_ms)
+                        if cand is not None:
+                            self._maybe_preempt_locked(cand)
                     self._cv.wait(timeout=self._wait_timeout_locked())
+                wait_ms = self.qos.note_dispatch_locked(
+                    handle, time.monotonic())
+                self.overload.record_wait(wait_ms)
                 self._n_active += 1
                 self._running.add(handle)
                 handle._mark_running()
@@ -290,48 +407,84 @@ class QueryScheduler:
             # a dispatcher idling between queries must not pin the last
             # handle (and through it the query's result/context) after
             # every caller reference is gone
-            del worker, handle, cand, entry
+            del worker, handle, cand
+
+    def _reservation_for_locked(self, handle: QueryHandle) -> int:
+        """The HBM reservation this query must hold: its tenant's
+        hbmFraction of the arena, or the scheduler-wide default."""
+        if self._dm is None:
+            return 0
+        frac = self.qos.get_locked(handle.tenant).hbm_fraction
+        if frac <= 0:
+            return self.reservation_bytes
+        return min(int(frac * self._dm.arena_bytes),
+                   self._dm.arena_bytes)
+
+    def _maybe_preempt_locked(self, cand: QueryHandle) -> None:
+        """Checkpoint-backed preemption decision: a strictly
+        higher-priority candidate blocked on a slot or its HBM
+        reservation evicts the lowest-priority running victim by
+        tripping its CancelToken — the victim unwinds through the
+        normal zero-leak cancellation path and ``_requeue_preempted``
+        puts it back in its tenant queue.  The ``preempt_victim``
+        event is emitted there, on the victim's own worker thread,
+        where its telemetry binding (and event ring) lives — the
+        dispatcher thread has no query binding
+        (tests/test_lint_qos.py allowlists this site for that
+        reason)."""
+        if not self.preemption_enabled:
+            return
+        if self._preempt_inflight is not None:
+            return  # one eviction at a time — no preemption cascades
+        victims = [h for h in self._running
+                   if h.priority < cand.priority]
+        if not victims:
+            return
+        victim = min(victims, key=lambda h: (h.priority, h.query_id))
+        victim._preempted_by = cand.query_id
+        if not victim.token.cancel(
+                f"preempted by query {cand.query_id} (priority "
+                f"{cand.priority} > {victim.priority})"):
+            victim._preempted_by = None  # already cancelled elsewhere
+            return
+        self._preempt_inflight = victim
+        log.info("query %d (priority %d) preempting query %d "
+                 "(priority %d)", cand.query_id, cand.priority,
+                 victim.query_id, victim.priority)
 
     def _wait_timeout_locked(self) -> Optional[float]:
         """How long the dispatcher may sleep: until the earliest
         queued entry would exceed its queue timeout (None = until
         notified)."""
-        if self.queue_timeout_ms <= 0 or not self._heap:
+        earliest = self.qos.earliest_queued_at_locked()
+        if self.queue_timeout_ms <= 0 or earliest is None:
             return None
-        now = time.monotonic()
         horizon = self.queue_timeout_ms / 1000.0
-        earliest = min(e[2]._queued_at for e in self._heap)
-        return max(0.01, earliest + horizon - now)
+        return max(0.01, earliest + horizon - time.monotonic())
 
     def _shed_expired_locked(self, now: float) -> None:
-        if not self._heap:
+        if self.queue_timeout_ms <= 0:
             return
-        horizon = (self.queue_timeout_ms / 1000.0
-                   if self.queue_timeout_ms > 0 else None)
-        keep = []
-        shed = []
-        for entry in self._heap:
-            h = entry[2]
+        horizon = self.queue_timeout_ms / 1000.0
+        for h in self.qos.all_queued_locked():
             if h._done.is_set():
-                continue  # cancelled while queued, already finished
-            if horizon is not None and now - h._queued_at >= horizon:
-                shed.append(h)
-            else:
-                keep.append(entry)
-        if len(keep) != len(self._heap):
-            self._heap = keep
-            heapq.heapify(self._heap)
-        for h in shed:
-            self._reject_queued(h, "queue_timeout")
+                self.qos.remove_locked(h)
+            elif now - h._queued_at >= horizon:
+                self.qos.remove_locked(h)
+                self._reject_queued(h, "queue_timeout")
 
     def _reject_queued(self, handle: QueryHandle, why: str) -> None:
         from ..telemetry.events import emit_event
 
+        wait_ms = round(
+            (time.monotonic() - handle._queued_at) * 1000.0, 1)
         emit_event("admission_reject", source="scheduler", reason=why,
-                   query_id=handle.query_id,
+                   query_id=handle.query_id, tenant=handle.tenant,
+                   queue_depth=self.qos.queued_count_locked(),
+                   queue_wait_ms=wait_ms,
                    queue_timeout_ms=self.queue_timeout_ms)
-        log.warning("query %d shed from the scheduler queue (%s)",
-                    handle.query_id, why)
+        log.warning("query %d shed from the scheduler queue (%s after "
+                    "%sms)", handle.query_id, why, wait_ms)
         handle._finish(QueryStatus.REJECTED, error=QueryRejected(
             f"query {handle.query_id} shed: {why} (queueTimeoutMs="
             f"{self.queue_timeout_ms})"))
@@ -343,6 +496,7 @@ class QueryScheduler:
         from ..fault.injector import bind_scoped_fault_injector
         from ..memory.retry import bind_scoped_injector
         from ..telemetry import spans as tspans
+        from ..telemetry.events import emit_event
         from . import cancel as _cancel
 
         token = handle.token
@@ -361,9 +515,23 @@ class QueryScheduler:
                     ctx_sink=sink)
                 handle.exec_path = "tpu"
                 self._attribute(handle, sink)
+                if handle.preemptions:
+                    # work-preserving resume evidence: the recovery
+                    # counters say how many stages were skipped
+                    emit_event(
+                        "preempt_resume", query_id=handle.query_id,
+                        tenant=handle.tenant,
+                        preemptions=handle.preemptions,
+                        stages_resumed=handle.metrics.get(
+                            "recovery.numStagesResumed", 0))
                 handle._finish(QueryStatus.FINISHED, result=out)
             except TpuQueryCancelled as e:
-                self._unwind_cancelled(handle, sink, e)
+                if handle._preempted_by is not None \
+                        and not handle._user_cancel \
+                        and not self._shutdown:
+                    self._requeue_preempted(handle, sink, e)
+                else:
+                    self._unwind_cancelled(handle, sink, e)
             except TpuFaultError as e:
                 if not self._degrade_enabled:
                     self._attribute(handle, sink)
@@ -398,7 +566,86 @@ class QueryScheduler:
                 self._n_active -= 1
                 self._running.discard(handle)
                 self._workers.discard(threading.current_thread())
+                if self._preempt_inflight is handle:
+                    self._preempt_inflight = None
+                self.qos.note_done_locked(
+                    handle, _DONE_COUNTER.get(handle.status()))
                 self._cv.notify_all()
+
+    # ----- preemption (victim side) -----------------------------------------
+    def _requeue_preempted(self, handle: QueryHandle, sink: Dict,
+                           exc: TpuQueryCancelled) -> None:
+        """Victim side of checkpoint-backed preemption: the same
+        zero-leak unwind as a terminal cancel (permits, upload caches
+        — the normal query-end path already freed shuffle slots and
+        finalized metrics), then back into the tenant queue instead of
+        a terminal CANCELLED.  Emits ``preempt_victim`` from the
+        victim's own telemetry binding, preserves the attempt's event
+        ring on the handle, and charges the preemption against the
+        victim's ``fault.maxTotalAttempts`` budget."""
+        from ..config import FAULT_MAX_TOTAL_ATTEMPTS
+        from ..telemetry.events import emit_event
+
+        handle.preemptions += 1
+        limit = self.session.conf.get(FAULT_MAX_TOTAL_ATTEMPTS)
+        emit_event("preempt_victim", query_id=handle.query_id,
+                   by_query=handle._preempted_by, tenant=handle.tenant,
+                   preemptions=handle.preemptions, reason=str(exc))
+        if self._dm is not None:
+            try:
+                self._dm.semaphore.release_task()
+            except Exception:  # noqa: BLE001 — unwind must not raise
+                pass
+        phys = sink.get("phys")
+        if phys is not None:
+            self._drop_upload_caches(phys)
+        # cooperative preemption carries no diagnosis: the frames'
+        # locals would pin device batches past the zero-leak contract
+        exc.__cause__ = None
+        exc.__context__ = None
+        if limit and handle.preemptions >= limit:
+            # terminal — _attribute keeps this attempt's ring on the
+            # handle, so no _prior_events copy (it would double up)
+            self._fail_preempt_budget(handle, sink, limit)
+            return
+        # keep the preempted attempt's ring visible on the handle (the
+        # resumed attempt begins a fresh one)
+        tele = getattr(sink.get("ctx"), "telemetry", None)
+        if tele is not None and tele.events is not None:
+            handle._prior_events.extend(tele.events.snapshot())
+        handle._preempted_by = None
+        log.warning("query %d preempted (x%d) — requeued for "
+                    "checkpoint-backed resume", handle.query_id,
+                    handle.preemptions)
+        dead = False
+        with self._cv:
+            if self._shutdown or handle._user_cancel:
+                dead = True
+            else:
+                handle._reset_for_requeue()
+                self.qos.requeue_front_locked(handle)
+                self._cv.notify_all()
+        if dead:
+            handle._finish(QueryStatus.CANCELLED,
+                           error=exc.with_traceback(None))
+
+    def _fail_preempt_budget(self, handle: QueryHandle, sink: Dict,
+                             limit: int) -> None:
+        """Terminal: the victim spent its whole fault.maxTotalAttempts
+        budget on preemptions — fail it instead of requeueing forever
+        (the same attempt-ceiling contract as stacked retries)."""
+        from ..fault.budget import AttemptBudgetExhausted
+        from ..telemetry.events import emit_event
+
+        ledger = [{"kind": "preempt", "count": handle.preemptions}]
+        emit_event("attempt_budget_exhausted",
+                   query_id=handle.query_id, limit=limit,
+                   attempts=handle.preemptions, ledger=ledger)
+        self._attribute(handle, sink)
+        handle._finish(QueryStatus.FAILED, error=AttemptBudgetExhausted(
+            f"query {handle.query_id} preempted {handle.preemptions} "
+            f"times — fault.maxTotalAttempts ({limit}) exhausted",
+            ledger))
 
     # ----- adaptive reservation rebase --------------------------------------
     def rebase_reservation(self, observed_bytes: int) -> int:
@@ -537,16 +784,16 @@ class QueryScheduler:
 
     # ----- lifecycle -------------------------------------------------------
     def shutdown(self, timeout: float = 10.0) -> None:
-        """Cancel queued + running queries, stop the dispatcher, and
-        join every scheduler thread."""
+        """Cancel queued + running queries, stop the dispatcher and
+        overload monitor, and join every scheduler thread."""
         with self._cv:
             already = self._shutdown
             self._shutdown = True
-            queued = [e[2] for e in self._heap]
-            self._heap = []
+            queued = self.qos.drain_all_locked()
             running = list(self._running)
             workers = list(self._workers)
             self._cv.notify_all()
+        self.overload.stop()
         for h in queued:
             h.token.cancel("scheduler shutdown")
             h._finish(QueryStatus.CANCELLED,
@@ -573,4 +820,15 @@ class QueryScheduler:
     @property
     def queued_count(self) -> int:
         with self._cv:
-            return len(self._heap)
+            return self.qos.queued_count_locked()
+
+    def qos_metrics(self) -> Dict[str, float]:
+        """``scheduler.tenant.<name>.*`` counters (submitted,
+        dispatched, finished, shed, preempted, queue waits, live
+        depths) plus the overload state — the serving-tier
+        observability surface (bench_serving.py, docs/qos.md)."""
+        with self._cv:
+            out = self.qos.metrics_locked()
+        out["scheduler.overloaded"] = \
+            1.0 if self.overload.overloaded else 0.0
+        return out

@@ -582,16 +582,22 @@ class Session:
                 self._scheduler = QueryScheduler(self)
             return self._scheduler
 
-    def submit(self, plan, priority: int = 0):
+    def submit(self, plan, priority: int = 0, tenant: str = "default"):
         """Submit a query (a DataFrame or logical plan) for concurrent
         execution; returns a ``QueryHandle`` with ``result()`` /
-        ``cancel()`` / ``status()``.  Admission is bounded
-        (``scheduler.maxConcurrent`` running + ``scheduler.maxQueued``
-        queued); a submit past the bound raises ``QueryRejected`` and
-        emits an ``admission_reject`` event."""
+        ``cancel()`` / ``status()``.  Queued queries drain by
+        per-tenant deficit-weighted fair share with priority aging
+        (``scheduler.tenant.<tenant>.*`` confs; see docs/qos.md).
+        Admission is bounded (``scheduler.maxConcurrent`` running +
+        ``scheduler.maxQueued`` queued); a submit past the bound raises
+        ``QueryRejected`` and emits an ``admission_reject`` event, and
+        under declared overload a low-tier submit is shed with the
+        retryable ``TpuOverloaded`` (its ``retry_after_ms`` is the
+        backoff hint)."""
         if isinstance(plan, DataFrame):
             plan = plan.plan
-        return self.scheduler.submit(plan, priority=priority)
+        return self.scheduler.submit(plan, priority=priority,
+                                     tenant=tenant)
 
     def shutdown_scheduler(self) -> None:
         """Stop the scheduler (cancelling queued + running queries) and

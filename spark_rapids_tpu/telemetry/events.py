@@ -37,7 +37,20 @@ noteworthy engine transition emits one flat JSON record:
                        the query (ENOSPC or any write failure),
 ``attempt_budget_exhausted`` — the per-query ``fault.maxTotalAttempts``
                        ceiling was crossed; carries the full attempt
-                       ledger (terminal, emitted exactly once).
+                       ledger (terminal, emitted exactly once),
+``overload_enter`` / ``overload_exit`` — the scheduler's
+                       OverloadMonitor crossed (or, with hysteresis,
+                       recovered from) the ``scheduler.overload.*``
+                       queue-wait-p95 / arena-pressure thresholds,
+``overload_shed``    — a low-tier submit was shed under overload with
+                       a retryable ``TpuOverloaded``; carries the
+                       ``retry_after_ms`` backoff hint,
+``preempt_victim``   — a running query was cooperatively cancelled to
+                       yield its slot/HBM reservation to a strictly
+                       higher-priority query and was requeued,
+``preempt_resume``   — a previously-preempted query completed; carries
+                       ``stages_resumed`` (checkpoint-backed resume
+                       evidence from the recovery counters).
 
 Emission contract: call sites OUTSIDE ``telemetry/`` must only use
 :func:`emit_event`, which is exception-safe (never raises, never

@@ -1,0 +1,142 @@
+"""AST lint: QoS shed/preempt telemetry and overload discipline.
+
+Load shedding and preemption are *silent* failure modes when their
+telemetry is missing — a client sees a rejection or a slow query and
+has no record of why.  Three properties are enforced mechanically:
+
+1. **Decision sites emit** — every function in the scheduler package
+   whose name marks a shed or preempt decision (``shed``/``preempt``
+   in the name) must call ``emit_event`` itself or via another
+   function in the same module, or appear in the allowlist with a
+   reason.
+2. **TpuOverloaded always carries the backoff hint** — no call site
+   anywhere in the package constructs ``TpuOverloaded`` without a
+   ``retry_after_ms`` keyword (the class enforces it at runtime; the
+   lint catches it before a test ever has to hit the path).
+3. **OverloadMonitor threads capture the telemetry binding** — the
+   sampler thread spawn must wrap its target with ``capture``/
+   ``bound`` (same discipline as test_lint_scheduler.py, pinned here
+   specifically so the monitor can never silently lose its ring).
+"""
+import ast
+import os
+import re
+
+PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "spark_rapids_tpu")
+
+DECISION_RE = re.compile(r"shed|preempt", re.IGNORECASE)
+
+#: "<file>:<function>" -> reason
+ALLOWLIST = {
+    "query_scheduler.py:_maybe_preempt_locked":
+        "dispatcher-side decision; the dispatcher thread has no query "
+        "telemetry binding — the victim emits preempt_victim from its "
+        "own worker thread in _requeue_preempted",
+    "qos.py:count_shed_locked":
+        "pure counter bump under _cv; the decision site "
+        "(_maybe_shed_overload_locked) emits overload_shed",
+}
+
+
+def _terminal_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _calls_in(node):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield _terminal_name(n.func)
+
+
+def _scheduler_sources():
+    base = os.path.join(PKG, "scheduler")
+    for fn in sorted(os.listdir(base)):
+        if fn.endswith(".py"):
+            path = os.path.join(base, fn)
+            yield fn, ast.parse(open(path).read(), filename=path)
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def test_every_shed_or_preempt_decision_site_emits_telemetry():
+    offenders, matched = [], 0
+    for fn, tree in _scheduler_sources():
+        funcs = {f.name: f for f in _functions(tree)}
+        # transitive emit closure WITHIN the module: f emits if it
+        # calls emit_event, or calls a module function that does
+        emits = {name for name, f in funcs.items()
+                 if "emit_event" in set(_calls_in(f))}
+        changed = True
+        while changed:
+            changed = False
+            for name, f in funcs.items():
+                if name in emits:
+                    continue
+                if set(_calls_in(f)) & emits:
+                    emits.add(name)
+                    changed = True
+        for name, f in funcs.items():
+            if not DECISION_RE.search(name):
+                continue
+            matched += 1
+            if f"{fn}:{name}" in ALLOWLIST:
+                continue
+            if name not in emits:
+                offenders.append(f"{fn}:{name} (line {f.lineno})")
+    # _maybe_shed_overload_locked / _shed_expired_locked /
+    # _requeue_preempted / _fail_preempt_budget at minimum
+    assert matched >= 4, \
+        f"decision-site scan matched only {matched} — lint broken?"
+    assert not offenders, \
+        "shed/preempt decision sites that never emit a telemetry " \
+        f"event (emit_event, directly or via this module): {offenders}"
+
+
+def test_no_tpu_overloaded_without_retry_after_ms():
+    sites, offenders = 0, []
+    for root, _dirs, files in os.walk(PKG):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            tree = ast.parse(open(path).read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) \
+                        or _terminal_name(node.func) != "TpuOverloaded":
+                    continue
+                sites += 1
+                kw = {k.arg for k in node.keywords}
+                if "retry_after_ms" not in kw and None not in kw:
+                    offenders.append(
+                        f"{os.path.relpath(path, PKG)}:{node.lineno}")
+    assert sites >= 1, "no TpuOverloaded construction found — scan broken?"
+    assert not offenders, \
+        "TpuOverloaded constructed without its retry_after_ms " \
+        f"backoff hint: {offenders}"
+
+
+def test_overload_monitor_thread_captures_binding():
+    path = os.path.join(PKG, "scheduler", "qos.py")
+    tree = ast.parse(open(path).read(), filename=path)
+    monitor = next(n for n in ast.walk(tree)
+                   if isinstance(n, ast.ClassDef)
+                   and n.name == "OverloadMonitor")
+    spawns = [n for n in ast.walk(monitor)
+              if isinstance(n, ast.Call)
+              and _terminal_name(n.func) == "Thread"]
+    assert spawns, "OverloadMonitor spawns no thread — scan broken?"
+    for node in spawns:
+        names = set(_calls_in(node))
+        assert names & {"capture", "bound", "attached"}, \
+            f"OverloadMonitor Thread spawn at qos.py:{node.lineno} " \
+            "missing the telemetry capture()/bound() wrapping"

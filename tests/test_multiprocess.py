@@ -15,6 +15,30 @@ import sys
 import pytest
 
 
+def _cpu_collectives_unavailable() -> str:
+    """Multi-process jax.distributed on the CPU backend needs the gloo
+    TCP collectives; some jaxlib builds ship without them, and every
+    worker then dies in ``jax.distributed.initialize``.  Detect that
+    at collection time instead of burning a subprocess timeout on the
+    known-doomed drill (ROADMAP "Known environment caveats")."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() not in ("", "cpu"):
+        return ""
+    try:
+        from jax.lib import xla_extension
+    except Exception as e:  # noqa: BLE001 — no jaxlib = no drill either
+        return f"jax.lib.xla_extension unavailable: {e!r}"
+    if not hasattr(xla_extension, "make_gloo_tcp_collectives"):
+        return ("this jaxlib build has no gloo TCP collectives "
+                "(xla_extension.make_gloo_tcp_collectives missing) — "
+                "multi-process CPU collectives cannot initialize")
+    return ""
+
+
+_SKIP_REASON = _cpu_collectives_unavailable()
+if _SKIP_REASON:
+    pytest.skip(_SKIP_REASON, allow_module_level=True)
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

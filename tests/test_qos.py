@@ -1,0 +1,372 @@
+"""Multi-tenant QoS (spark_rapids_tpu/scheduler/qos.py + the
+scheduler's preemption/shedding paths).
+
+The contracts under test:
+
+* **Weighted fair share** — under contention, dispatch counts converge
+  to the ``scheduler.tenant.<name>.weight`` ratio regardless of
+  arrival order; an idle tenant cannot bank virtual time into a burst.
+* **Priority aging** — a queued low-priority query accrues effective
+  priority with wait, so a steady high-priority stream can delay but
+  never indefinitely starve it (the PR 7 fixed-priority starvation
+  edge, pinned by a regression test).
+* **Checkpoint-backed preemption** — a strictly higher-priority query
+  evicts the lowest-priority running victim through the zero-leak
+  cancellation unwind; the victim requeues with its aging credit,
+  resumes from completed exchange checkpoints (``recovery.enabled``)
+  bit-identical with ``recovery.numStagesResumed > 0``, and every
+  preemption is charged against ``fault.maxTotalAttempts``.
+* **Overload shedding** — past the ``scheduler.overload.*`` thresholds
+  new low-tier submissions shed with the typed retryable
+  :class:`TpuOverloaded` carrying ``retry_after_ms``; transitions and
+  sheds emit ``overload_{enter,exit,shed}`` events.
+* **Admission observability** — every ``admission_reject`` carries the
+  queue depth and the victim's queue wait in milliseconds.
+"""
+import glob
+import os
+import threading
+import time
+
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.fault.budget import AttemptBudgetExhausted
+from spark_rapids_tpu.scheduler import QueryRejected, TpuOverloaded
+from spark_rapids_tpu.scheduler.qos import (OverloadMonitor,
+                                            TenantRegistry,
+                                            effective_priority)
+from spark_rapids_tpu.scheduler.query_scheduler import QueryStatus
+
+from test_scheduler import (FAST, SHUFFLED, _assert_unwound, _inject,
+                            _join_agg_df, _norm, _select_df,
+                            _wait_until)
+
+
+class _H:
+    """Stub QueryHandle for registry-level unit tests."""
+
+    _ids = iter(range(1, 10_000))
+
+    def __init__(self, tenant, priority, first_queued_at=None):
+        self.tenant = tenant
+        self.priority = priority
+        self.query_id = next(_H._ids)
+        self._queued_at = time.monotonic()
+        self._first_queued_at = (self._queued_at
+                                 if first_queued_at is None
+                                 else first_queued_at)
+        self._done = threading.Event()
+
+
+# ==========================================================================
+# Fair share + aging (registry-level, no session)
+# ==========================================================================
+def test_fair_share_interleave_matches_weights():
+    reg = TenantRegistry(TpuConf({
+        "spark.rapids.tpu.scheduler.tenant.gold.weight": 3.0,
+        "spark.rapids.tpu.scheduler.tenant.bronze.weight": 1.0,
+    }))
+    for _ in range(6):
+        reg.enqueue_locked(_H("gold", 0))
+        reg.enqueue_locked(_H("bronze", 0))
+    order = []
+    now = time.monotonic()
+    for _ in range(8):
+        h = reg.pick_locked(now, aging_ms=0)
+        reg.note_dispatch_locked(h, now)
+        order.append(h.tenant)
+    # vtime advances 1/weight per dispatch -> 3:1 service ratio
+    assert order.count("gold") == 6 and order.count("bronze") == 2, order
+    assert reg.tenants["gold"].vtime == pytest.approx(
+        reg.tenants["bronze"].vtime)
+
+
+def test_idle_tenant_cannot_bank_virtual_time():
+    reg = TenantRegistry(TpuConf())
+    now = time.monotonic()
+    # busy tenant dispatches 10 while "idle" has nothing queued
+    for _ in range(10):
+        reg.enqueue_locked(_H("busy", 0))
+        reg.note_dispatch_locked(reg.pick_locked(now, 0), now)
+    reg.enqueue_locked(_H("idle", 0))
+    # the floor: idle joins at the busy tenant's clock, not at 0 —
+    # otherwise it would win the next 10 dispatches as a burst
+    assert reg.tenants["idle"].vtime == pytest.approx(
+        reg.tenants["busy"].vtime)
+
+
+def test_priority_aging_overtakes_within_tenant():
+    reg = TenantRegistry(TpuConf())
+    now = time.monotonic()
+    old_low = _H("t", 0, first_queued_at=now - 1.0)  # waited 1s
+    fresh_high = _H("t", 5)
+    reg.enqueue_locked(old_low)
+    reg.enqueue_locked(fresh_high)
+    # aging off: static priority wins
+    assert reg.peek_locked(now, aging_ms=0) is fresh_high
+    # 100ms/level aging: 1s of wait = +10 effective levels
+    assert reg.peek_locked(now, aging_ms=100) is old_low
+    assert effective_priority(old_low, now, 100) == pytest.approx(10.0)
+
+
+# ==========================================================================
+# OverloadMonitor (unit, stubbed inputs)
+# ==========================================================================
+def test_overload_monitor_hysteresis_and_retry_hint():
+    conf = TpuConf({
+        "spark.rapids.tpu.scheduler.overload.queueWaitMs": 100,
+        "spark.rapids.tpu.scheduler.overload.retryAfterMs": 500,
+    })
+    inputs = {"waits": [], "pressure": 0.0}
+    mon = OverloadMonitor(conf, lambda: inputs["waits"],
+                          lambda: inputs["pressure"])
+    assert mon.enabled and not mon.overloaded
+    inputs["waits"] = [250.0] * 8  # p95 well past the threshold
+    assert mon.evaluate() is True
+    # hysteresis: recovery requires < 0.5x threshold, 60ms is not cool
+    inputs["waits"] = [60.0] * 8
+    assert mon.evaluate() is True
+    inputs["waits"] = [10.0] * 8
+    assert mon.evaluate() is False
+    assert [h["event"] for h in mon.history] == ["overload_enter",
+                                                 "overload_exit"]
+    # retry hint scales with queue depth
+    assert mon.retry_after_ms(0, 16) == 500
+    assert mon.retry_after_ms(16, 16) == 1000
+
+
+def test_tpu_overloaded_requires_retry_after_ms():
+    with pytest.raises(TypeError):
+        TpuOverloaded("no hint")  # retry_after_ms is kw-only required
+    e = TpuOverloaded("shed", retry_after_ms=750)
+    assert e.retry_after_ms == 750
+
+
+# ==========================================================================
+# Starvation regression (satellite: the PR 7 fixed-priority edge)
+# ==========================================================================
+def test_high_priority_stream_cannot_starve_queued_low():
+    """A STEADY stream of freshly-arriving priority-10 queries (always
+    >= 2 outstanding, replenished on completion) against one queued
+    priority-0 query.  Each new arrival starts with zero age while the
+    low query keeps accruing (20ms per effective level), so it
+    overtakes the stream instead of waiting for it to drain —
+    the PR 7 fixed-priority scheduler starved it indefinitely here.
+    Preemption is off: this pins the queue-ORDERING contract (an
+    evicted victim is the preemption tests' concern)."""
+    sess = srt.Session({
+        **FAST, **SHUFFLED,
+        "spark.rapids.tpu.scheduler.maxConcurrent": 1,
+        "spark.rapids.tpu.scheduler.preemption.enabled": False,
+        "spark.rapids.tpu.scheduler.priorityAgingMs": 20,
+    })
+    try:
+        first = sess.submit(_join_agg_df(sess), priority=10)
+        low = sess.submit(_join_agg_df(sess), priority=0)
+        highs = [first]
+        deadline = time.monotonic() + 120
+        while not low.done() and time.monotonic() < deadline:
+            highs = [h for h in highs if not h.done()]
+            while len(highs) < 2:
+                highs.append(sess.submit(_join_agg_df(sess),
+                                         priority=10))
+            time.sleep(0.01)
+        assert low.done(), \
+            "low-priority query starved by the high-priority stream"
+        low.result(timeout=10)
+        for h in highs:
+            h.result(timeout=180)
+    finally:
+        sess.shutdown_scheduler()
+        sess.close()
+
+
+# ==========================================================================
+# Overload shedding (behavioral)
+# ==========================================================================
+def test_overload_sheds_low_tier_with_retry_hint():
+    from spark_rapids_tpu.telemetry import spans
+
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=250.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.telemetry.enabled": True,
+           "spark.rapids.tpu.scheduler.maxConcurrent": 1,
+           "spark.rapids.tpu.scheduler.preemption.enabled": False,
+           "spark.rapids.tpu.scheduler.overload.queueWaitMs": 60,
+           "spark.rapids.tpu.scheduler.overload.shedBelowPriority": 5}))
+    tele = spans.QueryTelemetry(sess.conf)
+    spans.activate(tele)
+    try:
+        hs = [sess.submit(_join_agg_df(sess), priority=5,
+                          tenant="gold") for _ in range(2)]
+        # the queued query's live wait crosses 60ms -> overload
+        _wait_until(lambda: sess.scheduler.overload.evaluate(),
+                    timeout=30, msg="overload_enter")
+        with pytest.raises(TpuOverloaded) as ei:
+            sess.submit(_select_df(sess), priority=0, tenant="bronze")
+        assert ei.value.retry_after_ms > 0
+        # high-tier submissions are never shed
+        hs.append(sess.submit(_select_df(sess), priority=5,
+                              tenant="gold"))
+        for h in hs:
+            h.result(timeout=180)
+        shed = [e for e in tele.events.snapshot()
+                if e["event"] == "overload_shed"]
+        assert shed and shed[0]["retry_after_ms"] > 0 \
+            and shed[0]["tenant"] == "bronze", shed
+        assert [h["event"] for h in
+                sess.scheduler.overload.history][:1] == ["overload_enter"]
+        m = sess.scheduler.qos_metrics()
+        assert m["scheduler.tenant.bronze.shed"] >= 1
+    finally:
+        spans.deactivate()
+        sess.shutdown_scheduler()
+        sess.close()
+
+
+# ==========================================================================
+# Checkpoint-backed preemption
+# ==========================================================================
+def test_preemption_resumes_from_checkpoints_bit_identical(tmp_path):
+    """The ISSUE preemption drill: a low-tier shuffling query is
+    preempted mid-query by a high-tier one under maxConcurrent=1; both
+    finish bit-identical to serial, the victim's metrics show
+    ``recovery.numStagesResumed > 0`` (work-preserving resume), and
+    the unwind leaks nothing."""
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.read", delay_ms=300.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.telemetry.enabled": True,
+           "spark.rapids.tpu.scheduler.maxConcurrent": 1,
+           "spark.rapids.tpu.recovery.enabled": True,
+           "spark.rapids.tpu.recovery.dir": str(tmp_path)}))
+    try:
+        serial = _join_agg_df(sess).collect()
+        sel_serial = _select_df(sess).collect()
+        victim = sess.submit(_join_agg_df(sess), priority=0,
+                             tenant="bronze")
+        # exchange WRITES complete fast (the injected delay is on the
+        # read side), so checkpoints exist before the eviction
+        _wait_until(
+            lambda: glob.glob(os.path.join(
+                str(tmp_path), "*", "*", "manifest.json")),
+            timeout=60, msg="first exchange checkpoint")
+        pre = sess.submit(_select_df(sess), priority=10, tenant="gold")
+        assert _norm(pre.result(timeout=180).to_rows()) \
+            == _norm(sel_serial)
+        assert _norm(victim.result(timeout=180).to_rows()) \
+            == _norm(serial)
+        assert victim.preemptions >= 1  # charged to the victim
+        assert victim.metrics.get("recovery.numStagesResumed", 0) > 0
+        evs = [e["event"] for e in victim.events()]
+        assert "preempt_victim" in evs and "preempt_resume" in evs, evs
+        resume = [e for e in victim.events()
+                  if e["event"] == "preempt_resume"][0]
+        assert resume["stages_resumed"] > 0
+        del victim, pre
+        _assert_unwound(sess)
+    finally:
+        sess.shutdown_scheduler()
+        sess.close()
+
+
+def test_preemption_without_recovery_reruns_bit_identical():
+    """No recovery store: the victim loses its partial work but still
+    requeues (aging credit intact) and re-runs to the identical
+    result, with zero leaked permits/reservations/slots."""
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=150.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.scheduler.maxConcurrent": 1}))
+    try:
+        serial = _join_agg_df(sess).collect()
+        victim = sess.submit(_join_agg_df(sess), priority=0,
+                             tenant="bronze")
+        _wait_until(lambda: victim.status() == QueryStatus.RUNNING,
+                    timeout=60, msg="victim running")
+        pre = sess.submit(_select_df(sess), priority=10, tenant="gold")
+        pre.result(timeout=180)
+        assert _norm(victim.result(timeout=180).to_rows()) \
+            == _norm(serial)
+        assert victim.preemptions >= 1
+        assert victim.status() == QueryStatus.FINISHED
+        m = sess.scheduler.qos_metrics()
+        assert m["scheduler.tenant.bronze.preempted"] >= 1
+        del victim, pre
+        _assert_unwound(sess)
+    finally:
+        sess.shutdown_scheduler()
+        sess.close()
+
+
+def test_preemption_charges_and_exhausts_attempt_budget():
+    """fault.maxTotalAttempts=1: the first preemption spends the whole
+    attempt budget, so the victim fails terminally with
+    AttemptBudgetExhausted instead of requeueing forever."""
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=150.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.telemetry.enabled": True,
+           "spark.rapids.tpu.scheduler.maxConcurrent": 1,
+           "spark.rapids.tpu.fault.maxTotalAttempts": 1}))
+    try:
+        victim = sess.submit(_join_agg_df(sess), priority=0)
+        _wait_until(lambda: victim.status() == QueryStatus.RUNNING,
+                    timeout=60, msg="victim running")
+        pre = sess.submit(_select_df(sess), priority=10)
+        pre.result(timeout=180)
+        with pytest.raises(AttemptBudgetExhausted):
+            victim.result(timeout=180)
+        assert victim.status() == QueryStatus.FAILED
+        evs = [e["event"] for e in victim.events()]
+        assert "attempt_budget_exhausted" in evs, evs
+        del victim, pre
+        _assert_unwound(sess)
+    finally:
+        sess.shutdown_scheduler()
+        sess.close()
+
+
+# ==========================================================================
+# admission_reject observability (satellite)
+# ==========================================================================
+def test_admission_reject_events_carry_depth_and_wait():
+    from spark_rapids_tpu.telemetry import spans
+
+    sess = srt.Session(_inject(
+        "always", "delay", site="exchange.write", delay_ms=250.0,
+        **SHUFFLED,
+        **{"spark.rapids.tpu.telemetry.enabled": True,
+           "spark.rapids.tpu.scheduler.maxConcurrent": 1,
+           "spark.rapids.tpu.scheduler.maxQueued": 1,
+           "spark.rapids.tpu.scheduler.queueTimeoutMs": 150}))
+    tele = spans.QueryTelemetry(sess.conf)
+    spans.activate(tele)
+    try:
+        # the dispatcher thread captures this binding at creation, so
+        # ITS queue_timeout rejections land in this ring too
+        sched = sess.scheduler
+        running = sess.submit(_join_agg_df(sess))
+        _wait_until(lambda: sched.active_count == 1, timeout=60,
+                    msg="first query running")
+        queued = sess.submit(_join_agg_df(sess))
+        with pytest.raises(QueryRejected):
+            sess.submit(_select_df(sess))  # queue_full
+        # the queued query then exceeds queueTimeoutMs -> queue_timeout
+        with pytest.raises(QueryRejected):
+            queued.result(timeout=60)
+        running.result(timeout=180)
+        rejects = {e["reason"]: e for e in tele.events.snapshot()
+                   if e["event"] == "admission_reject"}
+        assert {"queue_full", "queue_timeout"} <= set(rejects), rejects
+        for ev in rejects.values():
+            assert "queue_depth" in ev and "queue_wait_ms" in ev, ev
+        assert rejects["queue_full"]["queue_depth"] >= 1
+        assert rejects["queue_timeout"]["queue_wait_ms"] >= 150
+    finally:
+        spans.deactivate()
+        sess.shutdown_scheduler()
+        sess.close()
