@@ -135,7 +135,8 @@ def dump_markdown() -> str:
     lines += ["", _MEMORY_ROBUSTNESS_DOC, "", _FAULT_TOLERANCE_DOC,
               "", _SCHEDULING_DOC, "", _QOS_DOC, "",
               _OBSERVABILITY_DOC, "", _PERF_TUNING_DOC, "",
-              _SHUFFLE_DOC, "", _ADAPTIVE_DOC, "", _RECOVERY_DOC]
+              _SHUFFLE_DOC, "", _ADAPTIVE_DOC, "", _RECOVERY_DOC, "",
+              _STREAMING_DOC]
     return "\n".join(lines)
 
 
@@ -209,6 +210,35 @@ checkpoints (`spark_rapids_tpu/recovery/`, docs/recovery.md):
   per-query ceiling across task retries, stage retries, shuffle
   fallbacks and ladder rungs; crossing it emits ONE terminal
   `attempt_budget_exhausted` event with the full attempt ledger."""
+
+
+_STREAMING_DOC = """\
+## Incremental streaming execution
+
+The `streaming.*` confs (table above) configure micro-batch
+continuous queries (`spark_rapids_tpu/streaming/`, docs/streaming.md):
+
+* **Micro-batch triggers** — `session.stream(plan)` returns a
+  `StreamHandle`; every `streaming.triggerIntervalMs` a tick discovers
+  newly arrived files (at most `streaming.maxBatchFiles` per batch),
+  pins the cumulative file list into the plan and executes it through
+  the PR-11 scheduler path under a per-batch
+  `streaming.batchDeadlineMs` deadline SLA.
+* **Incremental state on the recovery substrate** — each growing
+  exchange's partial-aggregate frames persist via the CheckpointStore;
+  the next tick executes only the delta files and MERGES their frames
+  after the checkpointed ones, so untouched partitions resume from
+  CRC-verified checkpoints instead of recomputing
+  (`streaming.recomputeFraction` < 1 in batch progress).
+* **Exactly-once ledger** — the source ledger under
+  `<recovery.dir>/streams/<stream-fingerprint>/` (relocatable via
+  `streaming.stateDir`) commits atomically AFTER each batch; a crash
+  between batches replays the tick idempotently
+  (`Session.resume_stream` in a fresh process, bit-identical results,
+  `recovery.numStagesResumed > 0`).
+* Every decision emits a `stream_*` telemetry event; results are
+  bit-identical to a cold recompute of the same cumulative input,
+  including under fault injection and ladder degradation."""
 
 
 _ADAPTIVE_DOC = """\
@@ -609,6 +639,44 @@ RECOVERY_KILL_AFTER_CHECKPOINTS = conf(
     "Test hook: SIGKILL the process immediately after the Nth "
     "successful checkpoint write (0 disables).  Drives the "
     "crash-and-resume integration tests").internal().int_conf(0)
+
+# --- incremental streaming execution (streaming/; reference: Structured
+# Streaming micro-batches over the Theseus-style checkpoint substrate) -----
+STREAMING_ENABLED = conf("spark.rapids.tpu.streaming.enabled").doc(
+    "Allow Session.stream(plan): micro-batch continuous queries over "
+    "arriving files, with incremental aggregate state persisted "
+    "through the recovery checkpoint store so each tick recomputes "
+    "only the partitions the new files touch (requires "
+    "recovery.enabled for incremental reuse; without it every batch "
+    "is a full recompute)").boolean_conf(False)
+STREAMING_TRIGGER_INTERVAL_MS = conf(
+    "spark.rapids.tpu.streaming.triggerIntervalMs").doc(
+    "Micro-batch trigger period, milliseconds: the stream's tick loop "
+    "polls the source directories this often; a tick that finds no "
+    "new or changed files emits stream_tick_skip and goes back to "
+    "sleep (0 means ticks run only via "
+    "StreamHandle.process_available())").int_conf(500)
+STREAMING_MAX_BATCH_FILES = conf(
+    "spark.rapids.tpu.streaming.maxBatchFiles").doc(
+    "Cap on NEW files admitted into one micro-batch; a backlog beyond "
+    "it is carried to later ticks (oldest first, stable discovery "
+    "order) with a stream_batch_capped event per capped tick (0 "
+    "disables the cap)").int_conf(0)
+STREAMING_BATCH_DEADLINE_MS = conf(
+    "spark.rapids.tpu.streaming.batchDeadlineMs").doc(
+    "Per-batch deadline SLA, milliseconds from dispatch, enforced "
+    "through the scheduler's cooperative CancelToken: a batch past it "
+    "unwinds with TpuQueryCancelled, the tick reports the miss "
+    "(stream_batch_error) and the ledger stays at the previous batch "
+    "— the next tick retries the same cumulative input (0 falls back "
+    "to scheduler.queryTimeoutMs)").int_conf(0)
+STREAMING_STATE_DIR = conf("spark.rapids.tpu.streaming.stateDir").doc(
+    "Directory holding stream ledgers (source fingerprints + batch "
+    "commit markers) under <stateDir>/<stream-fingerprint>/; empty "
+    "uses <recovery.dir>/streams/ (the ledger then lives beside the "
+    "checkpoints it references, which is what crash recovery wants, "
+    "in a subtree hygiene sweeps never touch)"
+).string_conf("")
 
 # --- concurrent query scheduler (scheduler/; reference: Theseus-style
 # admission + memory arbitration across concurrent queries) ----------------

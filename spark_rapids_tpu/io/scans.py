@@ -77,17 +77,39 @@ def expand_paths(paths: List[str]) -> List[str]:
     return discover_files(paths)[0]
 
 
+def file_fingerprint(path: str) -> dict:
+    """Stable identity record of one leaf file — ``path`` plus the
+    ``size``/``mtime_ns`` pair a single ``os.stat`` observes (−1/−1
+    when the file vanished between listing and stat).  THE shared
+    currency between the streaming source ledger and the recovery
+    data-material fingerprint: both consume these records, so a file is
+    stat-ed exactly once per discovery."""
+    try:
+        st = os.stat(path)
+        return {"path": path, "size": int(st.st_size),
+                "mtime_ns": int(st.st_mtime_ns)}
+    except OSError:
+        return {"path": path, "size": -1, "mtime_ns": -1}
+
+
 def discover_files(paths: List[str]):
     """Recursive file listing with Hive-partition discovery: files under
     ``key=value`` directories carry those values (reference:
     PartitioningAwareFileIndex + the per-batch constant append in
     ColumnarPartitionReaderWithPartitionValues.scala:96).
 
-    Returns ``(files, part_values, part_keys)`` — per-file dicts of raw
-    (string) partition values, and the ordered key list (empty for flat
-    layouts)."""
+    Returns ``(files, part_values, part_keys, fingerprints)`` — per-file
+    dicts of raw (string) partition values, the ordered key list (empty
+    for flat layouts), and one :func:`file_fingerprint` record per file
+    (stat-ed during the walk — discovery is the only stat pass)."""
     files: List[str] = []
     values: List[dict] = []
+    fingerprints: List[dict] = []
+
+    def add(path: str, acc) -> None:
+        files.append(path)
+        values.append(dict(acc))
+        fingerprints.append(file_fingerprint(path))
 
     def walk(d, acc):
         for f in sorted(os.listdir(d)):
@@ -99,25 +121,22 @@ def discover_files(paths: List[str]):
                 walk(full,
                      acc + [(k, unescape_path_name(v))] if eq else acc)
             else:
-                files.append(full)
-                values.append(dict(acc))
+                add(full, acc)
 
     for p in paths:
         if os.path.isdir(p):
             walk(p, [])
         elif any(ch in p for ch in "*?["):
             for g in sorted(globmod.glob(p)):
-                files.append(g)
-                values.append({})
+                add(g, [])
         else:
-            files.append(p)
-            values.append({})
+            add(p, [])
     keys: List[str] = []
     for pv in values:
         for k in pv:
             if k not in keys:
                 keys.append(k)
-    return files, values, keys
+    return files, values, keys, fingerprints
 
 
 def _infer_partition_fields(values: List[dict],
@@ -161,7 +180,7 @@ def _parse_partition_value(raw, dtype):
 def infer_schema(fmt: str, paths: List[str], options: dict) -> T.Schema:
     if fmt == "csv":
         validate_csv_options(options)
-    files, values, keys = discover_files(paths)
+    files, values, keys, _fps = discover_files(paths)
     if not files:
         raise FileNotFoundError(f"no files for {paths}")
     f0 = files[0]
@@ -212,10 +231,17 @@ class FileScanExec(P.PhysicalPlan):
     targets (reference: populateCurrentBlockChunk GpuParquetScan.scala:571)."""
 
     def __init__(self, fmt: str, files: List[str], schema: T.Schema,
-                 options: dict, conf, part_values=None, part_keys=None):
+                 options: dict, conf, part_values=None, part_keys=None,
+                 file_fingerprints=None):
         super().__init__()
         self.fmt = fmt
         self.files = files
+        #: per-file identity records captured at discovery time (path,
+        #: size, mtime_ns) — the recovery data-material fingerprint and
+        #: the streaming source ledger read THESE instead of re-stat-ing
+        self.file_fingerprints = (
+            file_fingerprints if file_fingerprints is not None
+            else [file_fingerprint(p) for p in files])
         self._schema = schema
         self.options = options
         self.max_rows = conf.get(READER_BATCH_SIZE_ROWS)
@@ -528,6 +554,7 @@ def validate_csv_options(options: dict) -> None:
 def create_scan_exec(node: L.FileScan, conf) -> FileScanExec:
     if node.fmt == "csv":
         validate_csv_options(node.options)
-    files, values, keys = discover_files(node.paths)
+    files, values, keys, fps = discover_files(node.paths)
     return FileScanExec(node.fmt, files, node.schema, node.options, conf,
-                        part_values=values, part_keys=keys)
+                        part_values=values, part_keys=keys,
+                        file_fingerprints=fps)

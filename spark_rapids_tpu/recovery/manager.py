@@ -80,18 +80,34 @@ def _digest(text: str) -> str:
     return hashlib.sha256(text.encode()).hexdigest()[:24]
 
 
+def file_material(fp: Dict) -> str:
+    """One file-fingerprint record rendered into the fingerprint
+    material string (shared between query and streaming fingerprints)."""
+    if int(fp.get("size", -1)) < 0:
+        return f"file:{fp.get('path')}:?"
+    return (f"file:{fp['path']}:{int(fp['size'])}:"
+            f"{int(fp['mtime_ns'])}")
+
+
 def _leaf_material(node, out: List[str]) -> None:
     """Collect leaf DATA identity in preorder: content checksums for
     in-memory relations (``.batches``), path+size+mtime for file scans
-    (``.files``) — duck-typed so io/ scan execs need no registration."""
+    — duck-typed so io/ scan execs need no registration.  Scan execs
+    expose ``file_fingerprints`` captured during discovery (a single
+    stat pass shared with the streaming ledger); the ``.files`` stat
+    fallback remains for exec-like objects without them."""
     batches = getattr(node, "batches", None)
     if batches is not None:
         from ..fault.integrity import checksum_host_batch
 
         for b in batches:
             out.append(f"batch:{checksum_host_batch(b)}")
+    fingerprints = getattr(node, "file_fingerprints", None)
     files = getattr(node, "files", None)
-    if isinstance(files, (list, tuple)):
+    if isinstance(fingerprints, (list, tuple)) and fingerprints:
+        for fp in fingerprints:
+            out.append(file_material(fp))
+    elif isinstance(files, (list, tuple)):
         for p in files:
             try:
                 st = os.stat(p)

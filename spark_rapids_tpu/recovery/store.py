@@ -27,8 +27,9 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -43,12 +44,48 @@ MANIFEST_VERSION = 1
 #: the read path
 QUARANTINE_PREFIX = "quarantine-"
 
+#: reserved subdirectory of the recovery root holding streaming ledgers
+#: (streaming/ledger.py) — never a query dir, never swept as one
+STREAMS_DIRNAME = "streams"
+
+#: process-global pin registry: ``realpath(root) -> {query_fp}``.  A
+#: pinned query dir holds the live aggregate state of an active stream;
+#: TTL/maxBytes sweeps must not evict it no matter how old or large.
+#: Pins are deliberately process-local (not persisted): a dead process
+#: has no live stream, so its pins SHOULD lapse and let hygiene run.
+_PINS: Dict[str, Set[str]] = {}
+_PINS_LOCK = threading.Lock()
+
 
 class CheckpointStore:
     """Filesystem half of recovery: frames + manifests under ``root``."""
 
     def __init__(self, root: str):
         self.root = root
+
+    # ----- pinning ---------------------------------------------------------
+    def _pin_key(self) -> str:
+        return os.path.realpath(self.root)
+
+    def pin(self, query_fp: str) -> None:
+        """Protect ``query_fp``'s checkpoints from TTL/maxBytes sweeps
+        for the lifetime of this process (or until :meth:`unpin`) — an
+        active stream's aggregate state lives there between ticks."""
+        with _PINS_LOCK:
+            _PINS.setdefault(self._pin_key(), set()).add(query_fp)
+
+    def unpin(self, query_fp: str) -> None:
+        with _PINS_LOCK:
+            pins = _PINS.get(self._pin_key())
+            if pins is not None:
+                pins.discard(query_fp)
+                if not pins:
+                    _PINS.pop(self._pin_key(), None)
+
+    def pinned(self) -> Set[str]:
+        """The query fingerprints currently pinned under this root."""
+        with _PINS_LOCK:
+            return set(_PINS.get(self._pin_key(), ()))
 
     # ----- layout ----------------------------------------------------------
     def query_dir(self, query_fp: str) -> str:
@@ -176,13 +213,18 @@ class CheckpointStore:
         exceeds ``recovery.maxBytes`` — least-recently-touched query
         directories (LRU by dir mtime, refreshed on every checkpoint
         write).  Quarantined exchanges expire with their query dir.
-        Never raises."""
+        Pinned query dirs (an active stream's aggregate state) and the
+        reserved ``streams`` ledger dir are skipped entirely.  Never
+        raises."""
         removed_tmp = fsio.sweep_tmp_files(self.root)
         removed_dirs = 0
         now = time.time()
+        protected = self.pinned()
         try:
             entries = []
             for name in os.listdir(self.root):
+                if name == STREAMS_DIRNAME or name in protected:
+                    continue
                 path = os.path.join(self.root, name)
                 if not os.path.isdir(path):
                     continue

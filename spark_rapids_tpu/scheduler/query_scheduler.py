@@ -104,12 +104,20 @@ class QueryHandle:
     """Caller-side handle of one submitted query."""
 
     def __init__(self, scheduler: "QueryScheduler", query_id: int,
-                 plan, priority: int, tenant: str = DEFAULT_TENANT):
+                 plan, priority: int, tenant: str = DEFAULT_TENANT,
+                 recovery=None, deadline_ms: Optional[int] = None):
         self._scheduler = scheduler
         self.query_id = query_id
         self.plan = plan
         self.priority = priority
         self.tenant = tenant
+        #: caller-provided RecoveryManager (streaming micro-batches
+        #: bring their own stream-scoped manager) — None means the
+        #: session builds the default per-query one
+        self.recovery = recovery
+        #: per-query deadline override (streaming batchDeadlineMs);
+        #: None/0 falls back to scheduler.queryTimeoutMs
+        self.deadline_ms = deadline_ms
         self.token = CancelToken(query_id)
         self._lock = threading.Lock()
         self._done = threading.Event()
@@ -268,7 +276,8 @@ class QueryScheduler:
 
     # ----- submission ------------------------------------------------------
     def submit(self, plan, priority: int = 0,
-               tenant: str = DEFAULT_TENANT) -> QueryHandle:
+               tenant: str = DEFAULT_TENANT, *, recovery=None,
+               deadline_ms: Optional[int] = None) -> QueryHandle:
         from ..telemetry.events import emit_event
 
         with self._cv:
@@ -295,7 +304,8 @@ class QueryScheduler:
                     f"{self.max_concurrent}, maxQueued="
                     f"{self.max_queued})")
             handle = QueryHandle(self, next(self._next_qid), plan,
-                                 priority, tenant)
+                                 priority, tenant, recovery=recovery,
+                                 deadline_ms=deadline_ms)
             self.qos.enqueue_locked(handle)
             self._cv.notify_all()
         return handle
@@ -500,9 +510,11 @@ class QueryScheduler:
         from . import cancel as _cancel
 
         token = handle.token
-        if self.query_timeout_ms and self.query_timeout_ms > 0:
-            token.deadline = (time.monotonic()
-                              + self.query_timeout_ms / 1000.0)
+        timeout_ms = handle.deadline_ms \
+            if handle.deadline_ms and handle.deadline_ms > 0 \
+            else self.query_timeout_ms
+        if timeout_ms and timeout_ms > 0:
+            token.deadline = time.monotonic() + timeout_ms / 1000.0
         _cancel.activate(token)
         holder = [reservation]
         with self._cv:
@@ -512,7 +524,7 @@ class QueryScheduler:
             try:
                 out = self.session._execute_native(
                     handle.plan, scheduled=True, cancel_token=token,
-                    ctx_sink=sink)
+                    ctx_sink=sink, recovery=handle.recovery)
                 handle.exec_path = "tpu"
                 self._attribute(handle, sink)
                 if handle.preemptions:

@@ -599,6 +599,45 @@ class Session:
         return self.scheduler.submit(plan, priority=priority,
                                      tenant=tenant)
 
+    # ----- continuous queries (streaming/) ----------------------------------
+    def stream(self, plan, trigger=None, priority: int = 0,
+               tenant: str = "default"):
+        """Start a continuous query over ``plan``'s file sources and
+        return a ``StreamHandle`` (``await_batch()`` / ``progress()`` /
+        ``stop()``).  Each micro-batch re-discovers the sources, merges
+        grown exchanges incrementally through the recovery substrate
+        and submits the cumulative plan via the scheduler with the
+        per-batch ``streaming.batchDeadlineMs`` deadline — every batch
+        result is bit-identical to a cold full recompute of the same
+        cumulative input.  ``trigger`` is the tick interval in ms
+        (default ``streaming.triggerIntervalMs``); ``trigger=0`` means
+        manual ticks via ``handle.process_available()``.  Requires
+        ``streaming.enabled``."""
+        from .config import STREAMING_ENABLED, STREAMING_TRIGGER_INTERVAL_MS
+        from .streaming.stream import StreamHandle
+
+        if not self.conf.get(STREAMING_ENABLED):
+            raise RuntimeError(
+                "streaming is disabled — set "
+                "spark.rapids.tpu.streaming.enabled=true")
+        if isinstance(plan, DataFrame):
+            plan = plan.plan
+        trigger_ms = self.conf.get(STREAMING_TRIGGER_INTERVAL_MS) \
+            if trigger is None else int(trigger)
+        return StreamHandle(self, plan, trigger_ms=trigger_ms,
+                            priority=priority, tenant=tenant)
+
+    def resume_stream(self, plan, trigger=None, priority: int = 0,
+                      tenant: str = "default"):
+        """Alias of :meth:`stream` that documents intent after a crash
+        or restart: resuming IS starting again — the durable ledger
+        (``streaming.stateDir``) carries the exactly-once position and
+        the pinned checkpoints carry the aggregate state, so the next
+        tick continues from the last COMMITTED batch.  Check
+        ``handle.resumed`` to confirm a ledger was found."""
+        return self.stream(plan, trigger=trigger, priority=priority,
+                           tenant=tenant)
+
     def shutdown_scheduler(self) -> None:
         """Stop the scheduler (cancelling queued + running queries) and
         join its threads; a later submit() starts a fresh one."""

@@ -1,0 +1,16 @@
+"""Incremental streaming execution on the recovery substrate.
+
+Micro-batch continuous queries: ``Session.stream(plan, trigger=...)``
+→ :class:`~.stream.StreamHandle`.  See docs/streaming.md.
+"""
+from .incremental import StreamRecoveryManager, stream_fingerprint
+from .ledger import SourceLedger, split_new_files
+from .stream import StreamHandle
+
+__all__ = [
+    "SourceLedger",
+    "StreamHandle",
+    "StreamRecoveryManager",
+    "split_new_files",
+    "stream_fingerprint",
+]

@@ -1,0 +1,122 @@
+"""Source ledger — the durable exactly-once record of one stream.
+
+One JSON document per stream under ``streaming.stateDir`` (default: the
+reserved ``streams/`` directory inside the recovery root, which the
+CheckpointStore hygiene sweep skips by name):
+
+::
+
+    <state root>/<stream fingerprint>/ledger.json
+
+It records, per committed micro-batch: the batch id, the per-source
+file-fingerprint lists the batch covered (the :func:`io.scans
+.file_fingerprint` records — path, size, mtime_ns), and the
+per-occurrence exchange fingerprints of the batch's plan.  The ledger
+is written atomically (utils/fsio temp+fsync+rename) strictly AFTER
+the batch result materialized: the ledger advancing IS the commit
+point.  A crash after checkpoint writes but before the ledger commit
+merely re-runs a tick that is idempotent over the same cumulative
+input — the merged checkpoint is found by fingerprint and reused.
+
+Host-only, like recovery/: no jax, no engine imports.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, List, Tuple
+
+from ..config import STREAMING_STATE_DIR
+from ..recovery.manager import resolve_root
+from ..recovery.store import STREAMS_DIRNAME
+from ..utils import fsio
+
+log = logging.getLogger(__name__)
+
+LEDGER_NAME = "ledger.json"
+LEDGER_VERSION = 1
+
+
+def stream_state_root(conf) -> str:
+    """Where stream ledgers live: ``streaming.stateDir`` when set, else
+    the reserved ``streams/`` dir under the recovery root."""
+    d = conf.get(STREAMING_STATE_DIR)
+    if d:
+        return d
+    return os.path.join(resolve_root(conf), STREAMS_DIRNAME)
+
+
+def fingerprints_match(a: Dict, b: Dict) -> bool:
+    return (a.get("path") == b.get("path")
+            and int(a.get("size", -1)) == int(b.get("size", -1))
+            and int(a.get("mtime_ns", -1)) == int(b.get("mtime_ns", -1)))
+
+
+def split_new_files(prev: List[Dict],
+                    cur: List[Dict]) -> Tuple[bool, List[Dict]]:
+    """``(prefix_stable, new_suffix)``: committed files must reappear
+    as an UNCHANGED prefix of the current (sorted) discovery — the
+    append-only source contract.  A rewritten, resized or removed
+    committed file breaks prefix stability and the caller falls back to
+    a full-recompute batch (correct, just not incremental)."""
+    if len(cur) < len(prev):
+        return False, []
+    for p, c in zip(prev, cur):
+        if not fingerprints_match(p, c):
+            return False, []
+    return True, cur[len(prev):]
+
+
+class SourceLedger:
+    """Load/commit surface of one stream's ledger document."""
+
+    def __init__(self, conf, stream_fp: str):
+        self.dir = os.path.join(stream_state_root(conf), stream_fp)
+        self.path = os.path.join(self.dir, LEDGER_NAME)
+        self.stream_fp = stream_fp
+        self.batch_id = 0
+        #: per-source committed fingerprint lists (source order = the
+        #: template plan's FileScan preorder position)
+        self.files: List[List[Dict]] = []
+        #: occurrence key -> exchange fingerprint of the last batch
+        self.exchanges: Dict[str, str] = {}
+
+    def load(self) -> bool:
+        """True when a committed ledger was loaded (stream resume)."""
+        try:
+            with open(self.path) as f:
+                m = json.load(f)
+            if not isinstance(m, dict) or "batch_id" not in m \
+                    or not isinstance(m.get("files"), list):
+                raise ValueError(f"malformed stream ledger: {self.path}")
+            self.batch_id = int(m["batch_id"])
+            self.files = [list(fps) for fps in m["files"]]
+            self.exchanges = dict(m.get("exchanges") or {})
+            return True
+        except FileNotFoundError:
+            return False
+        except Exception:  # noqa: BLE001 — a torn ledger restarts at batch 0
+            log.warning("stream ledger %s unreadable — restarting from "
+                        "batch 0", self.path, exc_info=True)
+            self.batch_id = 0
+            self.files = []
+            self.exchanges = {}
+            return False
+
+    def commit(self, batch_id: int, files: List[List[Dict]],
+               exchanges: Dict[str, str]) -> None:
+        """Atomically advance the ledger — the exactly-once commit
+        marker of one micro-batch.  OSError propagates: a batch whose
+        commit cannot land must NOT be reported committed."""
+        os.makedirs(self.dir, exist_ok=True)
+        fsio.atomic_write_json(self.path, {
+            "version": LEDGER_VERSION,
+            "stream": self.stream_fp,
+            "batch_id": int(batch_id),
+            "files": [list(fps) for fps in files],
+            "exchanges": dict(exchanges),
+        })
+        self.batch_id = int(batch_id)
+        self.files = [list(fps) for fps in files]
+        self.exchanges = dict(exchanges)

@@ -50,7 +50,25 @@ noteworthy engine transition emits one flat JSON record:
                        higher-priority query and was requeued,
 ``preempt_resume``   — a previously-preempted query completed; carries
                        ``stages_resumed`` (checkpoint-backed resume
-                       evidence from the recovery counters).
+                       evidence from the recovery counters),
+``stream_start`` / ``stream_stop`` — continuous-query lifecycle
+                       (streaming/); ``stream_start`` carries
+                       ``resumed`` when a durable ledger was loaded,
+``stream_tick_skip`` — a trigger tick found nothing to do (no new
+                       files) and skipped without a batch,
+``stream_batch_start`` / ``stream_batch_commit`` — one micro-batch ran;
+                       the commit carries latency, resumed/total stage
+                       counts and the batch's recompute fraction,
+``stream_batch_capped`` — ``streaming.maxBatchFiles`` deferred part of
+                       the discovered backlog to the next tick,
+``stream_batch_error`` — a micro-batch failed (deadline miss,
+                       preemption, execution error); the ledger did not
+                       advance, the next tick retries,
+``stream_incremental_merge`` — a grown exchange's delta frames were
+                       appended to its committed base checkpoint,
+``stream_incremental_skip`` — an exchange recomputes from scratch this
+                       batch; carries the reason (non-incremental plan
+                       shape, rewritten source, validation failure).
 
 Emission contract: call sites OUTSIDE ``telemetry/`` must only use
 :func:`emit_event`, which is exception-safe (never raises, never

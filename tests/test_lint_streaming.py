@@ -1,0 +1,225 @@
+"""AST lint: the discipline of the streaming subsystem.
+
+Three contracts, enforced at the source level so a refactor cannot
+silently regress them (mirrors tests/test_lint_recovery.py):
+
+* **Tick loops stay cancellable.**  Every ``while`` loop under
+  ``spark_rapids_tpu/streaming/`` must poll cooperative cancellation
+  (``check_cancel``/``cancelled``) or the stream's stop signal in its
+  test or body — a stream that cannot be stopped mid-loop would hold
+  its checkpoint pin (and a scheduler slot) forever.
+* **Durable stream state writes are atomic.**  Nothing in streaming/
+  may write a file directly (write-mode ``open``, ``tofile``): ledger
+  commits and checkpoint frames go through the shared ``utils/fsio``
+  temp+fsync+replace helpers, so a crash can never leave a torn ledger
+  a resuming process would trust.
+* **Every skip/cap/shed decision is observable.**  Functions whose
+  name marks a decision (``skip``/``cap``/``shed``) must emit a
+  ``stream_*`` event, every event emitted from streaming/ uses the
+  ``stream_`` namespace, and the documented catalog is actually
+  emitted somewhere.
+
+Plus the host-only rule shared with recovery/: streaming/ never
+imports jax (a resumed stream must replay its ledger and merge
+checkpoints from a process that may never touch an accelerator).
+"""
+import ast
+import os
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "spark_rapids_tpu")
+STREAMING = os.path.join(PKG, "streaming")
+
+ATOMIC_HELPERS = {"atomic_write_bytes", "atomic_write_json"}
+
+#: signals that make a ``while`` loop cooperatively stoppable
+CANCEL_MARKERS = {"check_cancel", "cancelled", "wait"}
+
+#: the stream_* events the docs/catalog promise — each must be emitted
+REQUIRED_EVENTS = {
+    "stream_start", "stream_stop", "stream_tick_skip",
+    "stream_batch_start", "stream_batch_commit", "stream_batch_capped",
+    "stream_batch_error", "stream_incremental_merge",
+    "stream_incremental_skip",
+}
+
+
+def _parse(path):
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _streaming_modules():
+    for fn in sorted(os.listdir(STREAMING)):
+        if fn.endswith(".py"):
+            yield fn, _parse(os.path.join(STREAMING, fn))
+
+
+def _terminal_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _calls_in(tree):
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _open_mode(call):
+    if len(call.args) >= 2:
+        arg = call.args[1]
+    else:
+        arg = next((kw.value for kw in call.keywords
+                    if kw.arg == "mode"), None)
+    if arg is None:
+        return "r"
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _names_in(node):
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+# ==========================================================================
+# Cancellable loops
+# ==========================================================================
+def test_every_while_loop_polls_cancellation_or_stop():
+    loops = 0
+    offenders = []
+    for fn, tree in _streaming_modules():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.While):
+                continue
+            loops += 1
+            names = _names_in(node.test) | _names_in(node)
+            stoppable = ("check_cancel" in names
+                         or "cancelled" in names
+                         or any(n.startswith("_stop") for n in names))
+            if not stoppable:
+                offenders.append(f"{fn}:{node.lineno} while-loop never "
+                                 "polls cancellation or stop")
+    assert loops >= 2, "streaming/ lost its tick/walk loops?"
+    assert not offenders, offenders
+
+
+# ==========================================================================
+# Atomic durable writes
+# ==========================================================================
+def test_no_direct_file_writes_in_streaming():
+    offenders = []
+    checked = 0
+    for fn, tree in _streaming_modules():
+        for call in _calls_in(tree):
+            checked += 1
+            name = _terminal_name(call.func)
+            if name == "open":
+                mode = _open_mode(call)
+                if mode is None or any(c in mode for c in "wa+x"):
+                    offenders.append(
+                        f"{fn}:{call.lineno} open(mode={mode!r})")
+            elif name == "tofile":
+                offenders.append(f"{fn}:{call.lineno} .tofile()")
+    assert checked >= 40, "lint saw suspiciously little code"
+    assert not offenders, (
+        "stream state writes must go through utils/fsio atomic "
+        f"helpers (temp+fsync+replace): {offenders}")
+
+
+def test_ledger_commit_uses_the_shared_fsio_helpers():
+    tree = _parse(os.path.join(STREAMING, "ledger.py"))
+    uses = [c for c in _calls_in(tree)
+            if _terminal_name(c.func) in ATOMIC_HELPERS]
+    assert len(uses) >= 1, (
+        "ledger.py no longer commits through utils/fsio — a torn "
+        "ledger would corrupt exactly-once resume")
+
+
+# ==========================================================================
+# Observable decisions
+# ==========================================================================
+def _emit_literals(tree):
+    for call in _calls_in(tree):
+        if _terminal_name(call.func) != "emit_event":
+            continue
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            yield call, call.args[0].value
+        else:
+            yield call, None
+
+
+def test_skip_cap_shed_decisions_emit_stream_events():
+    decisions = 0
+    offenders = []
+    for fn, tree in _streaming_modules():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not any(w in node.name for w in ("skip", "cap", "shed")):
+                continue
+            decisions += 1
+            emitted = [lit for c, lit in _emit_literals(node)
+                       if lit and lit.startswith("stream_")]
+            if not emitted:
+                offenders.append(
+                    f"{fn}:{node.lineno} decision {node.name}() emits "
+                    "no stream_* event")
+    assert decisions >= 3, "streaming/ lost its decision helpers?"
+    assert not offenders, offenders
+
+
+def test_streaming_events_use_the_stream_namespace_and_cover_catalog():
+    emitted = set()
+    offenders = []
+    for fn, tree in _streaming_modules():
+        for call, lit in _emit_literals(tree):
+            if lit is None:
+                offenders.append(
+                    f"{fn}:{call.lineno} emit_event with non-literal "
+                    "event type")
+            elif not lit.startswith("stream_"):
+                offenders.append(
+                    f"{fn}:{call.lineno} event {lit!r} outside the "
+                    "stream_ namespace")
+            else:
+                emitted.add(lit)
+    # stream.py owns the lifecycle/decision events; the tick also emits
+    # them via helpers in incremental.py
+    missing = REQUIRED_EVENTS - emitted
+    assert not offenders, offenders
+    assert not missing, (
+        f"catalogued stream events never emitted: {sorted(missing)}")
+
+
+# ==========================================================================
+# Host-only streaming
+# ==========================================================================
+def test_streaming_package_never_imports_jax():
+    offenders = []
+    for fn, tree in _streaming_modules():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for name in names:
+                if name == "jax" or name.startswith("jax."):
+                    offenders.append(f"{fn}:{node.lineno} imports {name}")
+    assert not offenders, (
+        "streaming/ must stay host-only (ledger replay + checkpoint "
+        f"merge must run on any rung, CPU included): {offenders}")
