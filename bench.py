@@ -66,6 +66,12 @@ QUERY_TABLES = {
     16: ["part", "partsupp", "supplier"],
 }
 ITERS = 3
+#: Artifact schema version, stamped into every summary (BENCH_LAST /
+#: BENCH_TPU_LAST and the line printed to stdout).  ``--compare``
+#: refuses to diff artifacts across versions: a regression gate that
+#: silently compares renamed/re-scoped fields reports garbage.  Bump
+#: whenever per_query/kernels field semantics change.
+SCHEMA_VERSION = 2
 #: wall-clock budget: ``--budget-s`` on the CLI (exported to the child
 #: via SRT_BENCH_BUDGET_S) or the env var directly.  Past the budget,
 #: remaining queries are marked ``"skipped": "budget"`` and the partial
@@ -621,6 +627,110 @@ def _transfer_split(sess, wall_s):
             "compute_s": round(max(wall_s - h2d - d2h, 0.0), 4)}
 
 
+def _kernel_rows(sess, top_n=8):
+    """Per-kernel roofline attribution of the most recent collect:
+    dispatch counts, wall, rows/bytes throughput, and padding waste
+    per compiled-kernel fingerprint, ranked by wall time (the warm
+    iterations ride the kernel cache, so this is steady-state compute
+    attribution, not compile time)."""
+    stats = getattr(sess, "last_kernel_profile", None)
+    if not stats:
+        return None
+    from spark_rapids_tpu.telemetry.profiler import roofline_rows
+
+    return roofline_rows(stats,
+                         getattr(sess, "last_h2d_ceiling_bps", 0.0),
+                         top_n=top_n)
+
+
+def _wall_per_dispatch(row):
+    w, d = row.get("wall_s"), row.get("dispatches")
+    if isinstance(w, (int, float)) and isinstance(d, (int, float)) and d:
+        return w / d
+    return None
+
+
+def compare_summaries(old, new, threshold=0.20):
+    """Regression gate core: diff two bench summary artifacts.
+
+    Returns a list of regression records — per-query warm (``tpu_s``)
+    and cold (``cold_s``) times, and per-kernel wall-per-dispatch
+    matched by kernel fingerprint — where the new value exceeds the
+    old by more than ``threshold`` (default 20%).  Raises ValueError
+    when the artifacts carry different ``schema_version``s: diffing
+    renamed/re-scoped fields would report garbage, so the gate refuses
+    and tells the caller to re-baseline instead.
+    """
+    ov, nv = old.get("schema_version"), new.get("schema_version")
+    if ov != nv:
+        raise ValueError(
+            f"schema mismatch: baseline artifact has schema_version="
+            f"{ov!r} but the new artifact has {nv!r}; regression "
+            f"deltas across schemas are meaningless — re-run the "
+            f"bench to produce a fresh baseline")
+    limit = 1.0 + threshold
+    regs = []
+    old_pq = old.get("per_query") or {}
+    new_pq = new.get("per_query") or {}
+    for q in sorted(set(old_pq) & set(new_pq)):
+        o, n = old_pq[q], new_pq[q]
+        if not isinstance(o, dict) or not isinstance(n, dict):
+            continue
+        for field in ("tpu_s", "cold_s"):
+            b, v = o.get(field), n.get(field)
+            if isinstance(b, (int, float)) and isinstance(v, (int, float)) \
+                    and b > 0 and v > b * limit:
+                regs.append({"query": q, "field": field,
+                             "old": b, "new": v,
+                             "ratio": round(v / b, 2)})
+        by_fp = {r.get("kernel"): r for r in (o.get("kernels") or [])
+                 if isinstance(r, dict)}
+        for r in (n.get("kernels") or []):
+            if not isinstance(r, dict):
+                continue
+            base = by_fp.get(r.get("kernel"))
+            if base is None:
+                continue  # new/recompiled kernel: no baseline to diff
+            bwpd, nwpd = _wall_per_dispatch(base), _wall_per_dispatch(r)
+            # sub-100µs dispatches are launch-latency noise, not
+            # kernel-performance signal — skip them
+            if bwpd and nwpd and bwpd > 1e-4 and nwpd > bwpd * limit:
+                regs.append({"query": q, "kernel": r.get("kernel"),
+                             "field": "wall_per_dispatch_s",
+                             "old": round(bwpd, 6),
+                             "new": round(nwpd, 6),
+                             "ratio": round(nwpd / bwpd, 2)})
+    return regs
+
+
+def compare_main(old_path, new_path, threshold=0.20):
+    """CLI wrapper for the regression gate.  Exit codes: 0 = no
+    regressions, 1 = regressions found, 2 = unusable inputs (missing
+    file, bad JSON, schema mismatch)."""
+    try:
+        with open(old_path, "r", encoding="utf-8") as f:
+            old = json.load(f)
+        with open(new_path, "r", encoding="utf-8") as f:
+            new = json.load(f)
+    except (OSError, ValueError) as e:
+        _emit({"compare": "error",
+               "detail": f"{type(e).__name__}: {e}"[:300]})
+        return 2
+    try:
+        regs = compare_summaries(old, new, threshold=threshold)
+    except ValueError as e:
+        _emit({"compare": "schema_mismatch", "detail": str(e),
+               "old_schema": old.get("schema_version"),
+               "new_schema": new.get("schema_version")})
+        return 2
+    _emit({"compare": "regressions" if regs else "ok",
+           "threshold_pct": round(threshold * 100, 1),
+           "old": os.path.basename(old_path),
+           "new": os.path.basename(new_path),
+           "regressions": regs})
+    return 1 if regs else 0
+
+
 def _atomic_write_json(path, obj) -> None:
     """Write a BENCH_* artifact atomically via the engine's shared
     temp+fsync+rename helper (spark_rapids_tpu/utils/fsio.py — the same
@@ -734,6 +844,7 @@ def main():
                 per[p.split(".")[0]] = obj
         synth = {"metric": "tpch_suite_throughput", "value": None,
                  "unit": "GB/s", "vs_baseline": None,
+                 "schema_version": SCHEMA_VERSION,
                  "platform": child_platform + "-wedged-midrun",
                  "per_query": per, "rc": proc.returncode,
                  "skipped": [f"q{qn}" for qn in sorted(QUERY_TABLES)
@@ -768,7 +879,10 @@ def child_main(platform):
     pq = _pandas_queries()
     pt = _pandas_tables(raw)
 
-    tpu = Session(dict(PRESSURE_CONF))
+    # the per-kernel profiler feeds the per-query "kernels" roofline
+    # section; its enabled-mode cost is one counter update per dispatch
+    tpu = Session({**PRESSURE_CONF,
+                   "spark.rapids.tpu.telemetry.profiler.enabled": True})
     cpu = Session(dict(PRESSURE_CONF), tpu_enabled=False)
 
     def mk_tables(sess):
@@ -819,6 +933,7 @@ def child_main(platform):
         tpu_s, noise = _best(lambda: df.collect(), warmup=0,
                              deadline=deadline)
         m = tpu.last_metrics or {}
+        kernels = _kernel_rows(tpu)
         disp = m.get("kernelCache.dispatches", 0)
         kc_hit = round(m.get("kernelCache.hits", 0) / disp, 3) \
             if disp else None
@@ -853,6 +968,8 @@ def child_main(platform):
             "aqe": _aqe_decisions(m),
             **split,
         }
+        if kernels:
+            rec["kernels"] = kernels
         per_query[f"q{qn}"] = rec
         _emit({"progress": f"q{qn}", **rec,
                "elapsed_s": round(time.perf_counter() - _T0, 1)})
@@ -934,6 +1051,9 @@ def child_main(platform):
         "value": round(suite_gbs, 3),
         "unit": "GB/s",
         "vs_baseline": round(suite_gbs / cpu_gbs, 3),
+        "schema_version": SCHEMA_VERSION,
+        "h2d_ceiling_gb_per_s": round(
+            getattr(tpu, "last_h2d_ceiling_bps", 0.0) / 1e9, 3),
         "sf": SF,
         "platform": platform,
         "queries": sorted(QUERY_TABLES),
@@ -970,6 +1090,17 @@ def _parse_args(argv):
              "skipped with a 'budget' marker and the partial summary "
              "is still written atomically (default: "
              "SRT_BENCH_BUDGET_S or 270)")
+    ap.add_argument(
+        "--compare", metavar="OLD.json", default=None,
+        help="regression gate: diff a fresh run (or --new) against "
+             "this baseline artifact; >20%% slower per-query "
+             "warm/cold times or per-kernel wall-per-dispatch exits "
+             "nonzero (1 = regressions, 2 = schema mismatch / "
+             "unreadable artifact)")
+    ap.add_argument(
+        "--new", metavar="NEW.json", default=None,
+        help="with --compare: diff these two artifacts directly "
+             "without running the bench")
     return ap.parse_args(argv)
 
 
@@ -979,4 +1110,13 @@ if __name__ == "__main__":
         BUDGET_S = _args.budget_s
         # the orchestrator's measurement child re-reads it from the env
         os.environ["SRT_BENCH_BUDGET_S"] = str(_args.budget_s)
-    sys.exit(main())
+    if _args.compare and _args.new:
+        # compare-only mode: no bench run, no jax init
+        sys.exit(compare_main(_args.compare, _args.new))
+    rc = main() or 0
+    if _args.compare:
+        # fresh run just landed atomically in BENCH_LAST.json — gate it
+        last = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_LAST.json")
+        rc = compare_main(_args.compare, last) or rc
+    sys.exit(rc)

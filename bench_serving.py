@@ -42,6 +42,10 @@ import threading
 import time
 
 QUERIES = [1, 3, 5, 6, 16]
+#: artifact schema version (see bench.py SCHEMA_VERSION): comparison
+#: tooling refuses to diff artifacts across versions
+SCHEMA_VERSION = 2
+
 TENANTS = {
     "gold": {"weight": 4.0, "priority": 5},
     "silver": {"weight": 2.0, "priority": 2},
@@ -336,6 +340,7 @@ def main(argv=None):
     ran = [r for r in rounds.values() if "skipped" not in r]
     summary = {
         "metric": "serving_stress",
+        "schema_version": SCHEMA_VERSION,
         "submissions": args.submissions,
         "sf": args.sf,
         "tenants": {t: {**TENANTS[t]} for t in TENANTS},

@@ -40,6 +40,10 @@ FAST = {
     "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
 }
 
+#: artifact schema version (see bench.py SCHEMA_VERSION): comparison
+#: tooling refuses to diff artifacts across versions
+SCHEMA_VERSION = 2
+
 INJECT_CONFS = {
     "none": {},
     # corrupt fires on WRITE sites only (read-side CRC catches it at
@@ -259,6 +263,7 @@ def main(argv=None):
 
     doc = {
         "metric": "streaming_microbatch",
+        "schema_version": SCHEMA_VERSION,
         "query": args.query,
         "sf": args.sf,
         "ticks": args.ticks,

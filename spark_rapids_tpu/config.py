@@ -455,6 +455,25 @@ docs/observability.md):
 * **Exporters** — Prometheus-text and JSON snapshots over the query
   metrics, plus an HBM-watermark timeline sampled from the
   DeviceManager every `telemetry.sampleHbmMs` milliseconds.
+  Dimensional keys (`scheduler.tenant.<name>.*`,
+  `shuffle.exchange<N>.*`) export with proper `tenant=`/`exchange=`
+  labels; the scheduler's queue-wait, per-tenant query-latency and
+  streaming batch-latency distributions export as real
+  `# TYPE histogram` families (`Session.metrics_text()`).
+* **Per-kernel profiler** — `telemetry.profiler.enabled` attributes
+  every jitted-kernel dispatch to a stable kernel fingerprint
+  (dispatches, wall, rows/bytes, padding waste) and renders a roofline
+  table against the measured host->device ceiling in
+  `Session.profile_report()` and the BENCH `kernels` section; the
+  disabled cost is one attribute read per dispatch (docs/profiling.md).
+* **Trace timelines** — `telemetry.trace.dir` exports one
+  Chrome-trace/Perfetto JSON per query (span tree as duration tracks,
+  HBM watermark as a counter track, ring events as instants), written
+  atomically.
+* **Latency histograms** — fixed log-scale bucket histograms
+  (`telemetry.histogram.windowS` sliding window for p50/p95/p99
+  readouts, cumulative buckets for prometheus) back the scheduler
+  queue-wait p95, per-tenant latency and streaming batch latency.
 
 With `telemetry.enabled=false` (the default) every emitter is a no-op
 and the metrics snapshot is byte-identical to the un-instrumented
@@ -1020,6 +1039,27 @@ TELEMETRY_MAX_EVENTS = conf("spark.rapids.tpu.telemetry.maxEvents").doc(
     "Capacity of the per-query in-memory event ring (oldest events are "
     "dropped first and counted); the JSONL file sink is append-only "
     "and unbounded").int_conf(4096)
+TELEMETRY_PROFILER_ENABLED = conf(
+    "spark.rapids.tpu.telemetry.profiler.enabled").doc(
+    "Per-kernel dispatch profiler: accumulates dispatch count, wall "
+    "time, rows/bytes and shape-bucketing padding waste per kernel "
+    "fingerprint (telemetry/profiler.py), rendered as a roofline table "
+    "in Session.profile_report() and the BENCH JSON kernels section.  "
+    "Independent of telemetry.enabled; the disabled hot-path cost is "
+    "one attribute read per dispatch").boolean_conf(False)
+TELEMETRY_TRACE_DIR = conf("spark.rapids.tpu.telemetry.trace.dir").doc(
+    "Directory for Chrome-trace/Perfetto JSON timelines (one "
+    "trace-<queryId>.json per query, written atomically at query "
+    "finish): span tree as duration events, HBM sampler timeline as a "
+    "counter track, scheduler/streaming events as instants.  Empty "
+    "disables trace export; requires telemetry.enabled").string_conf("")
+TELEMETRY_HISTOGRAM_WINDOW_S = conf(
+    "spark.rapids.tpu.telemetry.histogram.windowS").doc(
+    "Sliding-window span, seconds, for latency-histogram percentile "
+    "readouts (scheduler queue-wait, per-tenant query latency, "
+    "streaming batch latency).  Cumulative bucket counts exported to "
+    "prometheus are unaffected (they are monotonic by "
+    "definition)").int_conf(300)
 
 
 class TpuConf:

@@ -36,6 +36,7 @@ import time
 from collections import OrderedDict
 from typing import Callable, Optional, Tuple
 
+from ..telemetry.profiler import PROFILER, kernel_fingerprint
 from ..utils import metrics as M
 
 
@@ -64,15 +65,17 @@ class _CachedKernel:
     attributed to the dispatching exec's ``compileTime`` metric.
     """
 
-    __slots__ = ("_cache", "fn", "_jfn", "donated")
+    __slots__ = ("_cache", "fn", "_jfn", "donated", "fingerprint")
 
     def __init__(self, cache: "KernelCache", fn: Callable,
                  static_argnums: Tuple[int, ...],
-                 donate_argnums: Tuple[int, ...]):
+                 donate_argnums: Tuple[int, ...],
+                 fingerprint: Optional[str] = None):
         import jax
 
         self._cache = cache
         self.fn = fn  # the raw traceable body (runner/fusion reuse it)
+        self.fingerprint = fingerprint or kernel_fingerprint(None, fn)
         self.donated = bool(donate_argnums) and cache.donation_active()
         kwargs = {}
         if static_argnums:
@@ -88,9 +91,15 @@ class _CachedKernel:
             return None
 
     def __call__(self, *args, metrics=None):
+        # the disabled-profiler cost is this ONE attribute read — no
+        # allocation, no lock (tests/test_lint_profiler.py pins both)
+        prof = PROFILER if PROFILER.enabled else None
         before = self._shape_cache_size()
         t0 = time.perf_counter_ns()
         out = self._jfn(*args)
+        if prof is not None:
+            prof.record_dispatch(self.fingerprint,
+                                 time.perf_counter_ns() - t0, args, out)
         if before is None:
             self._cache._count(dispatches=1)
             return out
@@ -231,7 +240,8 @@ class KernelCache:
                         self._entries.move_to_end(use_key)
                         self._counters["sharedKernels"] += 1
                         return hit
-        kern = _CachedKernel(self, fn, static_argnums, donate_argnums)
+        kern = _CachedKernel(self, fn, static_argnums, donate_argnums,
+                             fingerprint=kernel_fingerprint(key, fn))
         if use_key is not None:
             with self._lock:
                 # a concurrent thread may have registered the same key

@@ -39,8 +39,9 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Callable, Dict, List, Optional
+
+from ..telemetry.histogram import LatencyHistogram
 
 DEFAULT_TENANT = "default"
 
@@ -106,7 +107,7 @@ class TenantState:
     """One tenant's queue, fair-share clock and counters."""
 
     def __init__(self, name: str, weight: float, max_concurrent: int,
-                 hbm_fraction: float):
+                 hbm_fraction: float, hist_window_s: float = 300.0):
         self.name = name
         self.weight = max(1e-6, float(weight))
         self.max_concurrent = int(max_concurrent)
@@ -117,6 +118,9 @@ class TenantState:
         self.queue: List = []  # FIFO of queued QueryHandles
         self.running = 0
         self.counters: Dict[str, float] = {c: 0 for c in _COUNTERS}
+        #: end-to-end (submit -> terminal) latency, sliding-window
+        #: p50/p95/p99 in qos_metrics + histogram prometheus exposition
+        self.latency_hist = LatencyHistogram(window_s=hist_window_s)
 
 
 class TenantRegistry:
@@ -125,7 +129,10 @@ class TenantRegistry:
     the scheduler's ``_cv``."""
 
     def __init__(self, conf):
+        from ..config import TELEMETRY_HISTOGRAM_WINDOW_S
+
         self._conf = conf
+        self._hist_window_s = max(1, conf.get(TELEMETRY_HISTOGRAM_WINDOW_S))
         self.tenants: Dict[str, TenantState] = {}
         #: dispatch order, (tenant, query_id) — test/bench-visible
         #: evidence of the fair-share interleave
@@ -139,7 +146,8 @@ class TenantRegistry:
                 name,
                 tenant_conf(self._conf, name, "weight", float, 1.0),
                 tenant_conf(self._conf, name, "maxConcurrent", int, 0),
-                tenant_conf(self._conf, name, "hbmFraction", float, 0.0))
+                tenant_conf(self._conf, name, "hbmFraction", float, 0.0),
+                hist_window_s=self._hist_window_s)
             self.tenants[name] = t
         return t
 
@@ -239,6 +247,14 @@ class TenantRegistry:
         t.running = max(0, t.running - 1)
         if counter is not None:
             t.counters[counter] += 1
+            if counter in ("finished", "failed", "cancelled"):
+                # end-to-end latency from the FIRST enqueue: a
+                # preemption victim's requeue wait stays inside its
+                # measured latency, exactly as its submitter saw it
+                first = getattr(handle, "_first_queued_at", None)
+                if first is not None:
+                    t.latency_hist.observe(
+                        max(0.0, (time.monotonic() - first) * 1000.0))
 
     def count_shed_locked(self, tenant: str) -> None:
         self.get_locked(tenant).counters["shed"] += 1
@@ -270,7 +286,15 @@ class TenantRegistry:
             out[pfx + "queued"] = len(t.queue)
             out[pfx + "running"] = t.running
             out[pfx + "weight"] = t.weight
+            for p, v in t.latency_hist.percentiles().items():
+                out[pfx + f"latency{p.capitalize()}Ms"] = round(v, 3)
         return out
+
+    def histograms_locked(self) -> List:
+        """``(family_suffix, labels, hist)`` triples for
+        ``prometheus_text(histograms=...)``."""
+        return [("query_latency_ms", {"tenant": name}, t.latency_hist)
+                for name, t in sorted(self.tenants.items())]
 
 
 def _p95(samples: List[float]) -> float:
@@ -308,8 +332,10 @@ class OverloadMonitor:
         self._queued_waits_ms = queued_waits_ms
         self._arena_pressure = arena_pressure
         self._lock = threading.Lock()
-        #: (monotonic ts, wait_ms) of recent dispatches/sheds
-        self._waits: deque = deque(maxlen=256)
+        #: queue-wait latency histogram (30s sliding window for the
+        #: overload p95 — the pre-PR-13 deque recency — while its
+        #: cumulative buckets feed the prometheus histogram exposition)
+        self.wait_hist = LatencyHistogram(window_s=30.0)
         self._overloaded = False
         #: enter/exit transition records (test/bench-visible)
         self.history: List[Dict] = []
@@ -326,21 +352,22 @@ class OverloadMonitor:
 
     # ----- inputs ----------------------------------------------------------
     def record_wait(self, wait_ms: float) -> None:
-        with self._lock:
-            self._waits.append((time.monotonic(), float(wait_ms)))
+        self.wait_hist.observe(float(wait_ms))
 
     def wait_p95(self, now: Optional[float] = None) -> float:
-        """p95 over recent (30s) recorded waits PLUS the live waits of
-        still-queued queries — a wedged queue must register as
-        overload even before anything dispatches."""
-        now = time.monotonic() if now is None else now
-        with self._lock:
-            recent = [w for ts, w in self._waits if now - ts <= 30.0]
+        """p95 over the histogram's sliding window (recent recorded
+        waits) PLUS the live waits of still-queued queries — a wedged
+        queue must register as overload even before anything
+        dispatches."""
         try:
-            recent.extend(self._queued_waits_ms())
+            live = list(self._queued_waits_ms())
         except Exception:  # noqa: BLE001 — monitor must never throw
-            pass
-        return _p95(recent)
+            live = []
+        # the live waits are exact values; merging them as raw samples
+        # next to the bucketed window keeps the wedged-queue signal
+        # unquantized (a single long-stuck query must cross the
+        # threshold at the threshold, not at the next bucket bound)
+        return max(self.wait_hist.percentile(95.0, now), _p95(live))
 
     def arena_pressure(self) -> float:
         try:

@@ -837,10 +837,22 @@ class QueryScheduler:
     def qos_metrics(self) -> Dict[str, float]:
         """``scheduler.tenant.<name>.*`` counters (submitted,
         dispatched, finished, shed, preempted, queue waits, live
-        depths) plus the overload state — the serving-tier
-        observability surface (bench_serving.py, docs/qos.md)."""
+        depths, latency percentiles) plus the overload state and the
+        queue-wait percentiles — the serving-tier observability
+        surface (bench_serving.py, docs/qos.md)."""
         with self._cv:
             out = self.qos.metrics_locked()
         out["scheduler.overloaded"] = \
             1.0 if self.overload.overloaded else 0.0
+        for p, v in self.overload.wait_hist.percentiles().items():
+            out[f"scheduler.queueWait{p.capitalize()}Ms"] = round(v, 3)
         return out
+
+    def histograms(self) -> List:
+        """``(family_suffix, labels, LatencyHistogram)`` triples for
+        ``telemetry.export.prometheus_text(histograms=...)``: the
+        queue-wait histogram plus one end-to-end latency histogram per
+        tenant."""
+        with self._cv:
+            out = self.qos.histograms_locked()
+        return [("queue_wait_ms", {}, self.overload.wait_hist)] + out

@@ -61,12 +61,20 @@ class Session:
         #: unless telemetry.enabled); Session.profiles keeps the last
         #: telemetry.maxQueryProfiles of them
         self.last_profile = None
+        #: per-kernel profiler delta of the most recent execution
+        #: ({fingerprint -> telemetry.profiler.KernelStat}; None unless
+        #: telemetry.profiler.enabled) + the observed h2d ceiling
+        self.last_kernel_profile = None
+        self.last_h2d_ceiling_bps = 0.0
         from collections import deque as _deque
 
         from .config import TELEMETRY_MAX_QUERY_PROFILES
 
         self._profiles = _deque(
             maxlen=max(1, self.conf.get(TELEMETRY_MAX_QUERY_PROFILES)))
+        #: weakrefs to live StreamHandles (metrics_text/metrics_json
+        #: fold their progress + latency histograms into the exports)
+        self._streams: List = []
         # logical-plan -> physical-plan cache: repeated collect() of the
         # same DataFrame reuses the exec instances and with them every
         # per-exec jit cache (without this, each collect re-traced and
@@ -97,6 +105,10 @@ class Session:
             from .exec.kernel_cache import GLOBAL as _kernel_cache
 
             _kernel_cache.configure(self.conf)
+            # the per-kernel dispatch profiler is process-wide too
+            from .telemetry.profiler import PROFILER as _profiler
+
+            _profiler.configure(self.conf)
             # reusable broadcast artifacts (reference:
             # GpuBroadcastExchangeExec's broadcast variable, built once
             # and shared by every consumer)
@@ -199,10 +211,13 @@ class Session:
 
         from .exec.kernel_cache import GLOBAL as _kernel_cache
 
+        from .telemetry.profiler import PROFILER as _profiler
+
         # snapshot BEFORE planning: exec construction is where keyed
         # kernels register (sharedKernels) and misses start compiling,
         # and it belongs to this query's kernelCache.* delta
         kc_mark = _kernel_cache.counters()
+        kp_mark = _profiler.mark()
         try:
             phys = self._plan_cache.get(plan)
         except TypeError:  # unhashable/unweakref-able plan
@@ -234,6 +249,7 @@ class Session:
                           cancel_token=cancel_token,
                           force_host_shuffle=force_host_shuffle)
         ctx.kernel_cache_mark = kc_mark
+        ctx.kernel_profiler_mark = kp_mark
         if recovery is not None:
             # stamp every exchange with its rung-invariant plan
             # fingerprint (re-stamping a cached tree is idempotent)
@@ -363,6 +379,16 @@ class Session:
                 getattr(ctx, "kernel_cache_mark", None)))
             merged.update(_shuffle_stats.metrics_since(
                 getattr(ctx, "shuffle_stats_mark", None)))
+            from .telemetry.profiler import PROFILER as _profiler
+
+            if _profiler.enabled:
+                # the per-kernel roofline delta of THIS query; the
+                # handle/profile read it because last_kernel_profile is
+                # last-writer-wins shared state (like last_metrics)
+                ctx.kernel_profile = _profiler.since(
+                    getattr(ctx, "kernel_profiler_mark", None))
+                self.last_kernel_profile = ctx.kernel_profile
+                self.last_h2d_ceiling_bps = _profiler.h2d_ceiling_bps()
             fsum = fault_summary(merged)
             if fsum:
                 log.warning(
@@ -387,6 +413,19 @@ class Session:
         final_phys = getattr(ctx, "aqe_final_phys", None) or phys
         ctx.profile = finish_query(self, ctx, phys=final_phys,
                                    metrics=merged)
+        if ctx.profile is not None:
+            kstats = getattr(ctx, "kernel_profile", None)
+            if kstats:
+                # the profile renders its own roofline section
+                ctx.profile.kernel_stats = kstats
+                ctx.profile.h2d_ceiling_bps = self.last_h2d_ceiling_bps
+            from .config import TELEMETRY_TRACE_DIR
+
+            trace_dir = self.conf.get(TELEMETRY_TRACE_DIR)
+            if trace_dir:
+                from .telemetry.trace import write_query_trace
+
+                write_query_trace(trace_dir, ctx.profile)
         nodes = getattr(ctx, "aqe_broadcast_nodes", None)
         if nodes:
             # dynamic-conversion build batches are keyed by weakrefs
@@ -624,8 +663,25 @@ class Session:
             plan = plan.plan
         trigger_ms = self.conf.get(STREAMING_TRIGGER_INTERVAL_MS) \
             if trigger is None else int(trigger)
-        return StreamHandle(self, plan, trigger_ms=trigger_ms,
-                            priority=priority, tenant=tenant)
+        handle = StreamHandle(self, plan, trigger_ms=trigger_ms,
+                              priority=priority, tenant=tenant)
+        import weakref
+
+        self._streams = [r for r in self._streams if r() is not None]
+        self._streams.append(weakref.ref(handle))
+        return handle
+
+    def active_streams(self) -> List:
+        """Live StreamHandles started by :meth:`stream`.  Stopped or
+        GC'd handles drop out — the scrape surface reflects what is
+        running, not what once ran (callers keep the handle if they
+        want its final progress)."""
+        out = []
+        for r in self._streams:
+            h = r()
+            if h is not None and not getattr(h, "_stopped", False):
+                out.append(h)
+        return out
 
     def resume_stream(self, plan, trigger=None, priority: int = 0,
                       tenant: str = "default"):
@@ -710,6 +766,43 @@ class Session:
         if self.last_profile is None:
             return ""
         return self.last_profile.render(top_n=top_n)
+
+    def export_metrics(self) -> Dict:
+        """One combined metrics dict for the exporters: the last
+        query's snapshot plus the scheduler's ``qos_metrics()`` (when a
+        scheduler exists — never created just to export) and every live
+        stream's ``streaming.*`` progress."""
+        merged = dict(self.last_metrics)
+        with self._scheduler_lock:
+            sched = self._scheduler
+        if sched is not None:
+            merged.update(sched.qos_metrics())
+        for h in self.active_streams():
+            merged.update(h.progress())
+        return merged
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of :meth:`export_metrics` plus
+        the latency histograms (scheduler queue-wait, per-tenant query
+        latency, streaming batch latency) as proper ``# TYPE
+        histogram`` families — the process scrape surface."""
+        from .telemetry.export import prometheus_text
+
+        with self._scheduler_lock:
+            sched = self._scheduler
+        hists = list(sched.histograms()) if sched is not None else []
+        for h in self.active_streams():
+            hists.append(("stream_batch_latency_ms",
+                          {"stream": h.stream_id}, h.latency_hist))
+        return prometheus_text(self.export_metrics(), histograms=hists)
+
+    def metrics_json(self) -> str:
+        """JSON snapshot of :meth:`export_metrics` (byte-stable for
+        identical state — exporter stability is what lets a scraper
+        diff two snapshots)."""
+        from .telemetry.export import json_snapshot
+
+        return json_snapshot(self.export_metrics())
 
     # ----- test hooks (reference: ExecutionPlanCaptureCallback) ------------
     def start_capture(self):

@@ -124,6 +124,13 @@ class StreamHandle:
         self._seq = 0
         self._last = None
         self._progress: List[Dict] = []
+        from ..config import TELEMETRY_HISTOGRAM_WINDOW_S
+        from ..telemetry.histogram import LatencyHistogram
+
+        #: per-batch commit latency: p50/p95/p99 in progress() and a
+        #: histogram family in Session.metrics_text()
+        self.latency_hist = LatencyHistogram(
+            window_s=max(1, conf.get(TELEMETRY_HISTOGRAM_WINDOW_S)))
         self._stopped = False
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -307,6 +314,7 @@ class StreamHandle:
         self._ledger.commit(batch_id, admitted,
                             mgr.exchange_fps if mgr is not None else {})
         latency_ms = (time.monotonic() - t0) * 1000.0
+        self.latency_hist.observe(latency_ms)
         emit_event("stream_batch_commit", stream=self.stream_id,
                    batch_id=batch_id, latency_ms=round(latency_ms, 3),
                    stages_resumed=resumed, stages_total=stamped,
@@ -325,6 +333,8 @@ class StreamHandle:
             "streaming.recomputeFraction": round(fraction, 4),
             "streaming.backlogFiles": deferred,
         }
+        for p, v in self.latency_hist.percentiles().items():
+            prog[f"streaming.batchLatency{p.capitalize()}Ms"] = round(v, 3)
         with self._cv:
             self._progress.append(prog)
             self._last = ("ok", out)

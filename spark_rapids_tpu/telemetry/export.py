@@ -38,11 +38,44 @@ def _split_metric(key: str) -> Tuple[str, str]:
     return "", key
 
 
+_TENANT_RE = re.compile(r"^scheduler\.tenant\.([^.]+)\.(.+)$")
+_EXCHANGE_RE = re.compile(r"^shuffle\.exchange(\d+)\.(.+)$")
+
+
+def _metric_labels(key: str) -> Tuple[str, str]:
+    """Dimensional metric keys -> (canonical metric name, extra label).
+
+    ``scheduler.tenant.<name>.<counter>`` and
+    ``shuffle.exchange<N>.<metric>`` carry a dimension *inside* the
+    key; flattening it into the sanitized metric name (the pre-PR-13
+    behavior) made per-tenant/per-exchange series impossible to
+    aggregate in PromQL.  They now export one canonical name with a
+    proper ``tenant=``/``exchange=`` label; every other key returns an
+    empty label and renders byte-identically to before.
+    """
+    m = _TENANT_RE.match(key)
+    if m:
+        return ("scheduler_tenant_" + _sanitize(m.group(2)),
+                f',tenant="{m.group(1)}"')
+    m = _EXCHANGE_RE.match(key)
+    if m:
+        return ("shuffle_exchange_" + _sanitize(m.group(2)),
+                f',exchange="{m.group(1)}"')
+    return "", ""
+
+
 def prometheus_text(metrics: Dict[str, int],
                     query_id: Optional[str] = None,
-                    hbm_timeline: Optional[List] = None) -> str:
+                    hbm_timeline: Optional[List] = None,
+                    histograms: Optional[List] = None) -> str:
     """Render a metric snapshot in the Prometheus text exposition
-    format (one gauge family, labeled by exec/metric; stable order)."""
+    format (one gauge family, labeled by exec/metric; stable order).
+
+    ``histograms``: optional ``[(family_suffix, labels, hist), ...]``
+    triples (``hist`` a :class:`~.histogram.LatencyHistogram`) rendered
+    as proper ``# TYPE <family> histogram`` blocks after the gauges —
+    the scheduler's queue-wait / per-tenant latency and the streaming
+    batch-latency histograms arrive this way."""
     family = f"{PROM_PREFIX}_metric"
     lines = [f"# HELP {family} spark-rapids-tpu query metric snapshot",
              f"# TYPE {family} gauge"]
@@ -51,12 +84,26 @@ def prometheus_text(metrics: Dict[str, int],
         val = metrics[key]
         if not isinstance(val, (int, float)):
             continue
+        name, extra = _metric_labels(key)
+        if name:
+            lines.append(
+                f'{family}{{exec="",name="{name}"{extra}{qlabel}}} {val}')
+            continue
         exec_name, metric = _split_metric(key)
         labels = (f'exec="{_sanitize(exec_name)}",'
                   if exec_name else 'exec="",')
         lines.append(
             f"{family}{{{labels}name=\"{_sanitize(metric)}\"{qlabel}}}"
             f" {val}")
+    if histograms:
+        from .histogram import prometheus_histogram_lines
+
+        grouped: Dict[str, List] = {}
+        for suffix, labels, hist in histograms:
+            grouped.setdefault(suffix, []).append((labels, hist))
+        for suffix in sorted(grouped):
+            lines.extend(prometheus_histogram_lines(
+                f"{PROM_PREFIX}_{_sanitize(suffix)}", grouped[suffix]))
     if hbm_timeline:
         # column 2 is the DeviceManager's tracked high-watermark — it
         # catches spikes that rise and free BETWEEN samples, which the

@@ -1,0 +1,214 @@
+"""Sliding-window latency histograms with fixed log-scale buckets.
+
+``LatencyHistogram`` records latencies in milliseconds into a fixed set
+of power-of-two buckets (reference: the log-linear layout of HdrHistogram
+and the prometheus client's exponential buckets).  Two views coexist:
+
+* **Cumulative** totals (never reset) feed the prometheus exposition —
+  a proper ``# TYPE <family> histogram`` with ``_bucket{le=...}``,
+  ``_sum`` and ``_count`` series, which must be monotonic.
+* A **sliding window** (``window_s`` seconds, rotated in fixed slices)
+  feeds the p50/p95/p99 readouts so dashboards and the scheduler's
+  OverloadMonitor react to *recent* latency, not the whole process
+  lifetime.
+
+Percentiles interpolate within the winning bucket between its lower and
+upper bound; samples beyond the last finite bound saturate the overflow
+bucket and report the last finite bound (a deliberate floor — the
+histogram cannot resolve beyond its range).
+
+All methods are thread-safe; ``observe`` is O(log n buckets) and never
+allocates on the hot path.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Upper bounds in milliseconds: 0.25ms .. ~2097s, factor 2 per bucket.
+_DEFAULT_BOUNDS_MS: Tuple[float, ...] = tuple(0.25 * (2 ** i)
+                                              for i in range(24))
+
+# Number of rotating slices the sliding window is divided into.  More
+# slices -> smoother expiry at the cost of memory (n_buckets ints each).
+_WINDOW_SLICES = 6
+
+
+class LatencyHistogram:
+    """Fixed-bucket log-scale histogram of latencies in milliseconds."""
+
+    __slots__ = ("bounds", "window_s", "_slice_s", "_lock",
+                 "_total", "_total_count", "_total_sum",
+                 "_slices", "_slice_epoch")
+
+    def __init__(self, window_s: float = 300.0,
+                 bounds_ms: Sequence[float] = _DEFAULT_BOUNDS_MS):
+        self.bounds = tuple(float(b) for b in bounds_ms)
+        self.window_s = float(window_s)
+        self._slice_s = max(self.window_s / _WINDOW_SLICES, 1e-3)
+        self._lock = threading.Lock()
+        n = len(self.bounds) + 1          # +1 overflow (+Inf) bucket
+        self._total = [0] * n
+        self._total_count = 0
+        self._total_sum = 0.0
+        self._slices = [[0] * n for _ in range(_WINDOW_SLICES)]
+        self._slice_epoch = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def observe(self, latency_ms: float, now: Optional[float] = None) -> None:
+        if latency_ms != latency_ms or latency_ms < 0:   # NaN / negative
+            latency_ms = 0.0
+        idx = bisect.bisect_left(self.bounds, latency_ms)
+        if now is None:
+            now = time.monotonic()
+        epoch = int(now / self._slice_s)
+        with self._lock:
+            self._rotate_locked(epoch)
+            self._total[idx] += 1
+            self._total_count += 1
+            self._total_sum += latency_ms
+            self._slices[epoch % _WINDOW_SLICES][idx] += 1
+
+    def _rotate_locked(self, epoch: int) -> None:
+        gap = epoch - self._slice_epoch
+        if gap <= 0:
+            return
+        for i in range(min(gap, _WINDOW_SLICES)):
+            sl = self._slices[(self._slice_epoch + 1 + i) % _WINDOW_SLICES]
+            for j in range(len(sl)):
+                sl[j] = 0
+        self._slice_epoch = epoch
+
+    # -- windowed percentile readout --------------------------------------
+
+    def _window_counts(self, now: Optional[float] = None) -> List[int]:
+        if now is None:
+            now = time.monotonic()
+        epoch = int(now / self._slice_s)
+        with self._lock:
+            self._rotate_locked(epoch)
+            counts = [0] * (len(self.bounds) + 1)
+            for sl in self._slices:
+                for j, c in enumerate(sl):
+                    counts[j] += c
+            return counts
+
+    def percentile(self, q: float, now: Optional[float] = None) -> float:
+        """q-th percentile (0..100) over the sliding window; 0.0 if empty."""
+        counts = self._window_counts(now)
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        rank = max(1, int(math.ceil(total * (q / 100.0))))
+        cum = 0
+        for j, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                if j >= len(self.bounds):        # overflow bucket
+                    return self.bounds[-1]
+                lo = self.bounds[j - 1] if j > 0 else 0.0
+                hi = self.bounds[j]
+                # linear interpolation of the rank within the bucket
+                frac = (rank - (cum - c)) / float(c)
+                return lo + (hi - lo) * frac
+        return self.bounds[-1]
+
+    def percentiles(self, now: Optional[float] = None
+                    ) -> Dict[str, float]:
+        """{"p50": ..., "p95": ..., "p99": ...} over the sliding window."""
+        counts = self._window_counts(now)
+        total = sum(counts)
+        out = {}
+        for label, q in (("p50", 50.0), ("p95", 95.0), ("p99", 99.0)):
+            out[label] = self._percentile_from_counts(counts, total, q)
+        return out
+
+    def _percentile_from_counts(self, counts: List[int], total: int,
+                                q: float) -> float:
+        if total == 0:
+            return 0.0
+        rank = max(1, int(math.ceil(total * (q / 100.0))))
+        cum = 0
+        for j, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                if j >= len(self.bounds):
+                    return self.bounds[-1]
+                lo = self.bounds[j - 1] if j > 0 else 0.0
+                frac = (rank - (cum - c)) / float(c)
+                return lo + (self.bounds[j] - lo) * frac
+        return self.bounds[-1]
+
+    def window_count(self, now: Optional[float] = None) -> int:
+        return sum(self._window_counts(now))
+
+    # -- cumulative view (prometheus) --------------------------------------
+
+    @property
+    def count(self) -> int:
+        return self._total_count
+
+    @property
+    def sum_ms(self) -> float:
+        return self._total_sum
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """[(le_bound_ms, cumulative_count), ...] ending with +Inf."""
+        with self._lock:
+            out = []
+            cum = 0
+            for j, b in enumerate(self.bounds):
+                cum += self._total[j]
+                out.append((b, cum))
+            cum += self._total[-1]
+            out.append((math.inf, cum))
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            n = len(self.bounds) + 1
+            self._total = [0] * n
+            self._total_count = 0
+            self._total_sum = 0.0
+            self._slices = [[0] * n for _ in range(_WINDOW_SLICES)]
+
+
+def _fmt_le(bound: float) -> str:
+    if math.isinf(bound):
+        return "+Inf"
+    if bound == int(bound):
+        return str(int(bound))
+    return repr(bound)
+
+
+def prometheus_histogram_lines(family: str,
+                               labeled: Sequence[Tuple[Dict[str, str],
+                                                       LatencyHistogram]]
+                               ) -> List[str]:
+    """Render one ``# TYPE <family> histogram`` exposition block.
+
+    ``labeled`` pairs a label dict (may be empty) with a histogram; all
+    pairs share the family.  Label values are escaped per the prometheus
+    text format (backslash, double-quote, newline).
+    """
+    lines = [f"# TYPE {family} histogram"]
+    for labels, hist in labeled:
+        base = ",".join(
+            f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items()))
+        for bound, cum in hist.cumulative_buckets():
+            sep = "," if base else ""
+            lines.append(
+                f'{family}_bucket{{{base}{sep}le="{_fmt_le(bound)}"}} {cum}')
+        lab = f"{{{base}}}" if base else ""
+        lines.append(f"{family}_sum{lab} {hist.sum_ms:.6g}")
+        lines.append(f"{family}_count{lab} {hist.count}")
+    return lines
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))

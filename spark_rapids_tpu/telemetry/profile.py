@@ -92,6 +92,11 @@ class QueryProfile:
         self.plan_text = (explain_analyze(plan, self.metrics)
                           if plan is not None else None)
         self.hbm_timeline = list(tele.hbm_timeline)
+        #: per-query kernel-profiler deltas ({fingerprint ->
+        #: profiler.KernelStat}) + the observed h2d ceiling — back-filled
+        #: by Session._finalize_metrics when the profiler conf is on
+        self.kernel_stats = None
+        self.h2d_ceiling_bps = 0.0
 
     # ------------------------------------------------------------------
     @property
@@ -168,6 +173,13 @@ class QueryProfile:
                 v = kc[k]
                 lines.append(f"  {k}: "
                              + (_fmt_ms(v) if k.endswith("Ns") else str(v)))
+        if self.kernel_stats:
+            from .profiler import render_roofline
+
+            lines.append("")
+            lines.extend(render_roofline(self.kernel_stats,
+                                         self.h2d_ceiling_bps,
+                                         top_n=max(top_n, 10)))
         aqe = {k.split(".", 1)[1]: v for k, v in self.metrics.items()
                if k.startswith("aqe.")}
         if aqe:

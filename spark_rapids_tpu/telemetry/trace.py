@@ -1,0 +1,159 @@
+"""Chrome-trace / Perfetto timeline export.
+
+Converts finished :class:`~.profile.QueryProfile` objects — span tree,
+HBM sampler timeline, and the structured event ring (scheduler
+admission/preempt/overload instants, streaming batch commits, retry
+events, ...) — into the Chrome Trace Event JSON format that Perfetto
+(ui.perfetto.dev) and chrome://tracing load directly.
+
+Track layout: each query is one *process* (pid) whose name is the query
+id; within it, tid 0 carries the root query span and every direct child
+subtree of the root (a stage, a worker-pool drain, an exec group) gets
+its own *thread* track, so concurrent stages render side by side
+instead of stacking into one incoherent lane.  The HBM watermark
+renders as a counter track; ring events render as instants.
+
+Clock mapping: spans are stamped with ``perf_counter_ns`` while events
+and HBM samples carry wall-clock ``time.time()``.  The exporter anchors
+both to the query's ``query_begin`` event (emitted at the same instant
+the root span starts), yielding one µs timeline that is clamped
+non-negative — Perfetto rejects negative timestamps.
+
+Writing goes through the fsio atomic helpers (crash mid-write leaves a
+sweepable temp file, never a torn trace); per-query auto-export is
+gated by the ``telemetry.trace.dir`` conf.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ..utils import fsio
+
+
+def _span_events(root_dict: Dict, pid: int, anchor_ns: int,
+                 out: List[Dict]) -> None:
+    """Emit one complete ("X") event per span node, assigning each
+    direct child subtree of the root its own tid track."""
+    tid_names: Dict[int, str] = {0: "query"}
+    next_tid = [0]
+
+    def emit(sp: Dict, tid: int, depth: int) -> None:
+        if depth == 1:
+            next_tid[0] += 1
+            tid = next_tid[0]
+            tid_names.setdefault(tid, f"{sp['kind']}:{sp['name']}")
+        args = {"kind": sp["kind"]}
+        for k in ("rows", "batches", "bytes"):
+            if sp.get(k):
+                args[k] = sp[k]
+        if sp.get("device_sync_ns"):
+            args["device_sync_us"] = round(sp["device_sync_ns"] / 1e3, 1)
+        if sp.get("attrs"):
+            args.update({f"attr.{k}": v for k, v in sp["attrs"].items()})
+        out.append({
+            "ph": "X",
+            "name": f"{sp['kind']}:{sp['name']}",
+            "pid": pid,
+            "tid": tid,
+            "ts": max(0.0, round((sp["start_ns"] - anchor_ns) / 1e3, 3)),
+            "dur": max(0.0, round(sp["wall_ns"] / 1e3, 3)),
+            "args": args,
+        })
+        for c in sp["children"]:
+            emit(c, tid, depth + 1)
+
+    emit(root_dict, 0, 0)
+    for tid, name in tid_names.items():
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "ts": 0,
+                    "args": {"name": name}})
+
+
+def _annotated_span_tree(span) -> Dict:
+    """Span.to_dict() plus the absolute start_ns each node needs for
+    timeline placement (to_dict() itself only keeps wall)."""
+    d = span.to_dict()
+    d["start_ns"] = span.start_ns
+    d["children"] = [_annotated_span_tree(c) for c in span.children]
+    return d
+
+
+def chrome_trace(profiles, include_events: bool = True) -> Dict:
+    """Build one Chrome-trace document from one or more finished
+    QueryProfiles (one pid track per query).  Pure function — callers
+    decide where the JSON goes."""
+    if not isinstance(profiles, (list, tuple)):
+        profiles = [profiles]
+    events: List[Dict] = []
+    for pid, prof in enumerate(profiles, start=1):
+        if prof is None:
+            continue
+        anchor_ns = prof.root.start_ns
+        ring = prof.events.snapshot() if prof.events is not None else []
+        # wall-clock anchor: the query_begin event fires at root-span
+        # start; fall back to the earliest stamped thing we have
+        anchor_epoch = None
+        for ev in ring:
+            if ev.get("event") == "query_begin":
+                anchor_epoch = ev["ts"]
+                break
+        if anchor_epoch is None:
+            candidates = [ev["ts"] for ev in ring if "ts" in ev]
+            candidates += [t[0] for t in prof.hbm_timeline]
+            anchor_epoch = min(candidates) if candidates else 0.0
+
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "ts": 0,
+                       "args": {"name": f"query {prof.query_id}"}})
+        _span_events(_annotated_span_tree(prof.root), pid, anchor_ns,
+                     events)
+        for ts, allocated, peak in prof.hbm_timeline:
+            events.append({
+                "ph": "C", "name": "HBM", "pid": pid, "tid": 0,
+                "ts": max(0.0, round((ts - anchor_epoch) * 1e6, 3)),
+                "args": {"allocated": allocated, "peak": peak},
+            })
+        if include_events:
+            for ev in ring:
+                etype = ev.get("event", "event")
+                if etype in ("query_begin", "query_end"):
+                    continue  # already delimited by the root span
+                args = {k: v for k, v in ev.items()
+                        if k not in ("ts", "event", "query")
+                        and isinstance(v, (str, int, float, bool))}
+                events.append({
+                    "ph": "i", "s": "t", "name": etype,
+                    "pid": pid, "tid": 0,
+                    "ts": max(0.0,
+                              round((ev.get("ts", anchor_epoch)
+                                     - anchor_epoch) * 1e6, 3)),
+                    "args": args,
+                })
+    # metadata (ts 0) first, then strictly non-decreasing timestamps —
+    # not required by the format, but it makes the artifact diffable
+    # and lets tests assert monotonicity directly
+    events.sort(key=lambda e: (0 if e["ph"] == "M" else 1,
+                               e["pid"], e["ts"]))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_trace(path: str, profiles, include_events: bool = True) -> str:
+    """Atomically write a combined trace for ``profiles`` to ``path``."""
+    doc = chrome_trace(profiles, include_events=include_events)
+    fsio.atomic_write_json(path, doc)
+    return path
+
+
+def write_query_trace(trace_dir: str, profile) -> Optional[str]:
+    """Per-query auto-export used by Session._finalize_metrics when
+    ``telemetry.trace.dir`` is set: ``<dir>/trace-<queryId>.json``.
+    Exception-safe — trace export must never fail a query."""
+    if not trace_dir or profile is None:
+        return None
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(trace_dir, f"trace-{profile.query_id}.json")
+        return write_trace(path, profile)
+    except Exception:  # noqa: BLE001
+        return None

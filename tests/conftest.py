@@ -140,8 +140,10 @@ def _reset_kernel_cache():
     later test of kernel sharing, and counter assertions must start
     from a clean slate."""
     from spark_rapids_tpu.exec.kernel_cache import GLOBAL
+    from spark_rapids_tpu.telemetry.profiler import PROFILER
 
     GLOBAL.reset()
+    PROFILER.reset()
     yield
 
 

@@ -1,0 +1,134 @@
+"""Automated bench regression gate (bench.py --compare).
+
+Contract under test (ISSUE 13): diffing two bench summary artifacts
+flags >20% regressions on per-query warm/cold times and per-kernel
+wall-per-dispatch (matched by kernel fingerprint), exits nonzero when
+any are found and zero on self-compare, and REFUSES (exit 2, clear
+message) to diff artifacts with different schema_version — a gate
+that silently compares re-scoped fields reports garbage.
+
+bench.py's import side effects are env-only (no jax init), so the
+compare core is unit-testable in-process; one subprocess test pins the
+CLI wiring and exit codes.
+"""
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bench
+
+BENCH_PY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def _summary():
+    return {
+        "metric": "tpch_suite_throughput",
+        "schema_version": bench.SCHEMA_VERSION,
+        "value": 1.5,
+        "per_query": {
+            "q1": {"tpu_s": 1.0, "cold_s": 2.0,
+                   "kernels": [
+                       {"kernel": "agg#abc123", "dispatches": 10,
+                        "wall_s": 0.5},
+                       {"kernel": "scan#def456", "dispatches": 5,
+                        "wall_s": 0.05},
+                   ]},
+            "q6": {"tpu_s": 0.5, "cold_s": 1.0},
+        },
+    }
+
+
+def test_self_compare_is_clean():
+    s = _summary()
+    assert bench.compare_summaries(s, copy.deepcopy(s)) == []
+
+
+def test_warm_time_regression_flagged_past_threshold():
+    old, new = _summary(), _summary()
+    new["per_query"]["q6"]["tpu_s"] = 0.55       # +10%: within noise
+    assert bench.compare_summaries(old, new) == []
+    new["per_query"]["q6"]["tpu_s"] = 0.65       # +30%: regression
+    regs = bench.compare_summaries(old, new)
+    assert [r["field"] for r in regs] == ["tpu_s"]
+    assert regs[0]["query"] == "q6" and regs[0]["ratio"] == 1.3
+
+
+def test_cold_time_and_improvements():
+    old, new = _summary(), _summary()
+    new["per_query"]["q1"]["cold_s"] = 3.0       # +50% compile time
+    new["per_query"]["q6"]["tpu_s"] = 0.1        # improvement: not flagged
+    regs = bench.compare_summaries(old, new)
+    assert [(r["query"], r["field"]) for r in regs] == [("q1", "cold_s")]
+
+
+def test_synthetic_2x_kernel_slowdown_flagged():
+    old, new = _summary(), _summary()
+    new["per_query"]["q1"]["kernels"][0]["wall_s"] = 1.0   # 2x per dispatch
+    regs = bench.compare_summaries(old, new)
+    assert len(regs) == 1
+    r = regs[0]
+    assert r["kernel"] == "agg#abc123"
+    assert r["field"] == "wall_per_dispatch_s"
+    assert r["ratio"] == 2.0
+    # unmatched fingerprints (recompiled/renamed kernels) are skipped,
+    # not treated as regressions
+    new["per_query"]["q1"]["kernels"][0]["kernel"] = "agg#zzz999"
+    assert bench.compare_summaries(old, new) == []
+
+
+def test_schema_mismatch_refused_with_clear_message():
+    old, new = _summary(), _summary()
+    old["schema_version"] = 1
+    with pytest.raises(ValueError, match="schema mismatch"):
+        bench.compare_summaries(old, new)
+    # a baseline predating the version field is also a mismatch
+    del old["schema_version"]
+    with pytest.raises(ValueError, match="re-run the bench"):
+        bench.compare_summaries(old, new)
+
+
+def test_compare_main_exit_codes(tmp_path):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_summary()))
+    slow = _summary()
+    slow["per_query"]["q1"]["tpu_s"] = 9.9
+    new.write_text(json.dumps(slow))
+    assert bench.compare_main(str(old), str(old)) == 0
+    assert bench.compare_main(str(old), str(new)) == 1
+    skewed = _summary()
+    skewed["schema_version"] = 99
+    new.write_text(json.dumps(skewed))
+    assert bench.compare_main(str(old), str(new)) == 2
+    assert bench.compare_main(str(old), str(tmp_path / "absent.json")) == 2
+    (tmp_path / "torn.json").write_text('{"truncated": ')
+    assert bench.compare_main(str(old), str(tmp_path / "torn.json")) == 2
+
+
+def test_cli_compare_only_mode_never_runs_the_bench(tmp_path):
+    """--compare OLD --new NEW diffs without probing a backend; the
+    whole invocation is sub-second and the exit code is the verdict."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_summary()))
+    slow = _summary()
+    slow["per_query"]["q1"]["kernels"][0]["wall_s"] = 1.0
+    new.write_text(json.dumps(slow))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, BENCH_PY, "--compare", str(old),
+         "--new", str(old)],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert '"compare": "ok"' in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, BENCH_PY, "--compare", str(old),
+         "--new", str(new)],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "wall_per_dispatch_s" in bad.stdout

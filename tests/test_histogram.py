@@ -1,0 +1,93 @@
+"""Sliding-window latency histogram (telemetry/histogram.py).
+
+Contract under test (ISSUE 13): percentiles read from a sliding
+window (old samples expire), the prometheus view stays cumulative and
+monotone, and the edge cases are pinned — empty histogram reports 0.0,
+a single sample lands inside its bucket, and samples beyond the last
+finite bound saturate the overflow bucket instead of inventing
+latencies the histogram cannot resolve.
+"""
+import math
+
+from spark_rapids_tpu.telemetry.histogram import (
+    _DEFAULT_BOUNDS_MS, LatencyHistogram, prometheus_histogram_lines)
+
+
+def test_empty_histogram_reports_zero():
+    h = LatencyHistogram(window_s=10.0)
+    assert h.percentile(50.0, now=0.0) == 0.0
+    assert h.percentiles(now=0.0) == {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    assert h.count == 0 and h.sum_ms == 0.0
+    assert h.window_count(now=0.0) == 0
+    # cumulative view still renders a full (all-zero) bucket ladder
+    buckets = h.cumulative_buckets()
+    assert buckets[-1] == (math.inf, 0)
+    assert len(buckets) == len(_DEFAULT_BOUNDS_MS) + 1
+
+
+def test_single_sample_lands_in_its_bucket():
+    h = LatencyHistogram(window_s=10.0)
+    h.observe(3.0, now=1.0)          # bucket (2, 4]
+    for q in (50.0, 95.0, 99.0):
+        v = h.percentile(q, now=1.0)
+        assert 2.0 < v <= 4.0, (q, v)
+    assert h.count == 1 and h.sum_ms == 3.0
+
+
+def test_overflow_saturates_at_last_finite_bound():
+    h = LatencyHistogram(window_s=10.0)
+    h.observe(10.0 * _DEFAULT_BOUNDS_MS[-1], now=1.0)
+    assert h.percentile(99.0, now=1.0) == _DEFAULT_BOUNDS_MS[-1]
+    # the sample is counted in the +Inf bucket, not a finite one
+    buckets = h.cumulative_buckets()
+    assert buckets[-1] == (math.inf, 1)
+    assert buckets[-2][1] == 0
+
+
+def test_nan_and_negative_clamp_to_zero():
+    h = LatencyHistogram(window_s=10.0)
+    h.observe(float("nan"), now=1.0)
+    h.observe(-5.0, now=1.0)
+    assert h.count == 2
+    assert h.sum_ms == 0.0
+    assert h.percentile(99.0, now=1.0) <= _DEFAULT_BOUNDS_MS[0]
+
+
+def test_window_expiry_drops_old_samples_but_not_totals():
+    h = LatencyHistogram(window_s=6.0)   # slice = 1s, 6 slices
+    for i in range(10):
+        h.observe(100.0, now=1.0)
+    # well past the window: percentiles forget, totals do not
+    assert h.percentile(95.0, now=100.0) == 0.0
+    assert h.window_count(now=100.0) == 0
+    assert h.count == 10
+    assert h.cumulative_buckets()[-1][1] == 10
+
+
+def test_percentile_ordering_and_interpolation():
+    h = LatencyHistogram(window_s=60.0)
+    for ms in (1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 500.0):
+        h.observe(ms, now=1.0)
+    p = h.percentiles(now=1.0)
+    assert p["p50"] <= p["p95"] <= p["p99"]
+    # p50 sits in the (0.5, 1] bucket; p99 in 500's bucket (256, 512]
+    assert p["p50"] <= 1.0
+    assert 256.0 < p["p99"] <= 512.0
+
+
+def test_prometheus_lines_shape_and_escaping():
+    h = LatencyHistogram(window_s=10.0)
+    h.observe(1.0, now=1.0)
+    lines = prometheus_histogram_lines(
+        "f_ms", [({}, h), ({"tenant": 'a"b\\c'}, h)])
+    assert lines[0] == "# TYPE f_ms histogram"
+    assert f'f_ms_bucket{{le="+Inf"}} 1' in lines
+    assert "f_ms_count 1" in lines
+    assert "f_ms_sum 1" in lines
+    # label values escaped per the text exposition format
+    assert any(ln.startswith('f_ms_bucket{tenant="a\\"b\\\\c",le=')
+               for ln in lines)
+    # cumulative bucket counts are monotone within each series
+    unlabeled = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+                 if ln.startswith("f_ms_bucket{le=")]
+    assert unlabeled == sorted(unlabeled)

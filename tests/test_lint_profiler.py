@@ -1,0 +1,137 @@
+"""AST lint: kernel-profiler hot-path discipline (ISSUE 13).
+
+Three mechanical contracts, enforced like the jit/telemetry lints:
+
+1. **One timing authority** — no function in ``exec/`` outside
+   ``kernel_cache.py`` both reads ``perf_counter*`` and dispatches a
+   ``jit_kernel``; ad-hoc stopwatches around dispatches would fork the
+   attribution the profiler owns.
+2. **No host syncs in the profiler path** — ``telemetry/profiler.py``
+   and ``exec/kernel_cache.py`` never call ``block_until_ready`` /
+   ``np.asarray`` / ``device_get`` / ``tolist``: the profiler reads
+   shape metadata only, so enabling it cannot serialize the async
+   dispatch stream.
+3. **Disabled-mode shape** — ``_CachedKernel.__call__`` takes the
+   profiler reference via the one-attribute-read guard
+   (``PROFILER if PROFILER.enabled else None``) and calls
+   ``record_dispatch`` only under an ``is not None`` test, so the
+   disabled cost stays one getattr and zero allocations.
+"""
+import ast
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXEC_PKG = os.path.join(ROOT, "spark_rapids_tpu", "exec")
+PROFILER_PY = os.path.join(ROOT, "spark_rapids_tpu", "telemetry",
+                           "profiler.py")
+KERNEL_CACHE_PY = os.path.join(EXEC_PKG, "kernel_cache.py")
+
+_SYNC_CALLS = {"block_until_ready", "asarray", "device_get", "tolist"}
+
+
+def _term(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _call_names(tree):
+    return {_term(n.func) for n in ast.walk(tree)
+            if isinstance(n, ast.Call)}
+
+
+def test_no_ad_hoc_stopwatch_around_dispatches():
+    offenders = []
+    for fn in sorted(os.listdir(EXEC_PKG)):
+        if not fn.endswith(".py") or fn == "kernel_cache.py":
+            continue
+        path = os.path.join(EXEC_PKG, fn)
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            names = _call_names(node)
+            if names & {"perf_counter", "perf_counter_ns"} \
+                    and "jit_kernel" in names:
+                offenders.append(f"{fn}:{node.lineno}:{node.name}")
+    assert not offenders, \
+        "function times jit_kernel dispatches with a raw " \
+        "perf_counter — dispatch wall belongs to the kernel " \
+        f"profiler (telemetry/profiler.py): {offenders}"
+
+
+def test_profiler_path_never_syncs_the_device():
+    offenders = []
+    for path in (PROFILER_PY, KERNEL_CACHE_PY):
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _term(node.func) in _SYNC_CALLS:
+                offenders.append(
+                    f"{os.path.basename(path)}:{node.lineno}:"
+                    f"{_term(node.func)}")
+    assert not offenders, \
+        "host-sync call in the profiler hot path — shape metadata " \
+        f"only: {offenders}"
+
+
+def _cached_kernel_call():
+    tree = ast.parse(open(KERNEL_CACHE_PY).read(),
+                     filename=KERNEL_CACHE_PY)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "_CachedKernel":
+            for fn in node.body:
+                if isinstance(fn, ast.FunctionDef) \
+                        and fn.name == "__call__":
+                    return fn
+    raise AssertionError("_CachedKernel.__call__ not found")
+
+
+def test_dispatch_guard_is_one_attribute_read():
+    fn = _cached_kernel_call()
+    # the guard: prof = PROFILER if PROFILER.enabled else None
+    guards = [
+        n for n in ast.walk(fn)
+        if isinstance(n, ast.IfExp)
+        and isinstance(n.test, ast.Attribute)
+        and n.test.attr == "enabled"
+        and isinstance(n.orelse, ast.Constant)
+        and n.orelse.value is None]
+    assert guards, \
+        "_CachedKernel.__call__ lost the one-attribute-read profiler " \
+        "guard (prof = PROFILER if PROFILER.enabled else None)"
+    # record_dispatch only under `prof is not None` — never
+    # unconditionally (disabled mode must not allocate or lock)
+    recorded = [n for n in ast.walk(fn)
+                if isinstance(n, ast.Call)
+                and _term(n.func) == "record_dispatch"]
+    assert recorded, "__call__ no longer reports to the profiler"
+    guarded = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If) \
+                and isinstance(node.test, ast.Compare) \
+                and any(isinstance(op, ast.IsNot)
+                        for op in node.test.ops):
+            guarded.extend(n for n in ast.walk(node)
+                           if isinstance(n, ast.Call)
+                           and _term(n.func) == "record_dispatch")
+    assert set(map(id, recorded)) == set(map(id, guarded)), \
+        "record_dispatch call outside the `prof is not None` guard"
+
+
+def test_lint_watches_real_sites():
+    """Self-check: the contracts above are attached to live code —
+    kernel_cache actually dispatches through the profiler and the h2d
+    recorder is wired in transitions.py (an empty scan would mean the
+    lints watch nothing)."""
+    kc_names = _call_names(ast.parse(open(KERNEL_CACHE_PY).read()))
+    assert "record_dispatch" in kc_names
+    trans = os.path.join(EXEC_PKG, "transitions.py")
+    assert "record_h2d" in _call_names(ast.parse(open(trans).read()))
+    prof_tree = ast.parse(open(PROFILER_PY).read())
+    defs = {n.name for n in ast.walk(prof_tree)
+            if isinstance(n, ast.FunctionDef)}
+    assert {"record_dispatch", "record_h2d", "mark", "since"} <= defs

@@ -1,0 +1,150 @@
+"""Per-kernel dispatch profiler (telemetry/profiler.py).
+
+Contract under test (ISSUE 13): with ``telemetry.profiler.enabled``
+every jitted-kernel dispatch is attributed to a deterministic kernel
+fingerprint — dispatch count, wall, input rows/bytes, padding waste —
+and a TPC-H q1 run reconciles with its scan input within padding
+tolerance; the roofline report ranks kernels against the measured h2d
+ceiling; per-query deltas come from mark()/since(); disabled mode
+records nothing and changes no results, and enabling the profiler
+keeps fused vs unfused plans bit-identical.
+"""
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.telemetry.profiler import (PROFILER, KernelStat,
+                                                 kernel_fingerprint,
+                                                 roofline_rows)
+
+SF = 0.0007
+SEED = 7
+PROF = {"spark.rapids.tpu.telemetry.profiler.enabled": True}
+
+
+def _agg_df(sess, n=512):
+    rng = np.random.RandomState(5)
+    df = sess.create_dataframe({
+        "g": rng.randint(0, 8, n),
+        "v": (rng.rand(n) * 10).round(6)})
+    return df.group_by("g").agg(F.sum("v").alias("s"))
+
+
+# ==========================================================================
+# Fingerprints
+# ==========================================================================
+def test_fingerprint_deterministic_and_key_sensitive():
+    def fn(x):
+        return x
+
+    key = ("agg", ("sum", "float64"), 128)
+    fp1 = kernel_fingerprint(key, fn)
+    fp2 = kernel_fingerprint(key, fn)
+    assert fp1 == fp2                      # stable (no hash() seed)
+    assert fp1.startswith("agg#")
+    assert fp1 != kernel_fingerprint(("agg", ("sum", "float64"), 256), fn)
+    # anonymous path: no key -> qualified function name
+    assert "fn" in kernel_fingerprint(None, fn)
+
+
+# ==========================================================================
+# Attribution on TPC-H q1
+# ==========================================================================
+def test_q1_attribution_reconciles_with_scan_input():
+    raw = tpch_datagen.generate(SF, seed=SEED)
+    n_li = len(raw["lineitem"][1]["l_quantity"])
+    # telemetry on as well: the roofline table rides profile_report()
+    sess = srt.Session(dict(
+        PROF, **{"spark.rapids.tpu.telemetry.enabled": True}))
+    tables = {name: sess.create_dataframe(cols, schema)
+              for name, (schema, cols) in raw.items()}
+    df = tpch.QUERIES[1](tables)
+    df.collect()
+    df.collect()   # warm run: steady-state attribution, compile excluded
+    stats = sess.last_kernel_profile
+    assert stats, "profiler recorded no kernels for q1"
+    per = list(stats.values())
+    # the scan-side kernel saw every lineitem row (summed over batches)
+    assert any(s.in_rows == n_li for s in per), \
+        [(k, s.in_rows) for k, s in stats.items()]
+    scan_like = max(per, key=lambda s: s.in_rows)
+    assert scan_like.in_bytes >= n_li * 8    # >= one float64 column
+    # padding tolerance: logical rows never exceed padded rows, waste
+    # is a fraction
+    for s in per:
+        assert s.dispatches >= 1 and s.wall_ns >= 0
+        if s.in_padded_known:
+            assert s.in_rows <= s.in_padded_known
+        assert 0.0 <= s.padding_waste <= 1.0
+    # q1 is agg-dominated: the top-3 kernels by wall carry the
+    # majority of attributed compute
+    walls = sorted((s.wall_ns for s in per), reverse=True)
+    assert sum(walls[:3]) >= 0.5 * sum(walls)
+    # roofline rows are ranked by wall and carry derived rates
+    rows = roofline_rows(stats, sess.last_h2d_ceiling_bps, top_n=10)
+    assert rows == sorted(rows, key=lambda r: -r["wall_s"])
+    for r in rows:
+        assert r["bytes_per_s"] >= 0 and r["rows_per_s"] >= 0
+    # the session report renders the roofline table
+    assert "Kernel roofline" in sess.profile_report()
+
+
+# ==========================================================================
+# mark()/since() per-query deltas
+# ==========================================================================
+def test_mark_since_isolates_queries():
+    sess = srt.Session(dict(PROF))
+    _agg_df(sess).collect()
+    first = sess.last_kernel_profile
+    assert first and all(s.dispatches > 0 for s in first.values())
+    _agg_df(sess, n=1024).collect()
+    second = sess.last_kernel_profile
+    assert second
+    # the second query's delta counts only its own dispatches: the
+    # cached kernels re-dispatch, so counts must not accumulate
+    for fp, s in second.items():
+        if fp in first:
+            assert s.dispatches <= first[fp].dispatches * 2
+    total = PROFILER.snapshot()
+    for fp, s in second.items():
+        assert total[fp].dispatches >= s.dispatches
+
+
+def test_kernel_stat_delta_arithmetic():
+    a = KernelStat()
+    a.dispatches, a.wall_ns, a.in_rows = 5, 1000, 50
+    b = KernelStat()
+    b.dispatches, b.wall_ns, b.in_rows = 2, 400, 20
+    d = KernelStat.from_delta(a.as_tuple(), b.as_tuple())
+    assert (d.dispatches, d.wall_ns, d.in_rows) == (3, 600, 30)
+
+
+# ==========================================================================
+# Disabled mode
+# ==========================================================================
+def test_disabled_mode_records_nothing():
+    sess = srt.Session()
+    _agg_df(sess).collect()
+    assert sess.last_kernel_profile is None
+    assert PROFILER.enabled is False
+    assert PROFILER.mark() == {}
+    assert PROFILER.snapshot() == {}
+    assert "Kernel roofline" not in (sess.profile_report() or "")
+
+
+# ==========================================================================
+# Bit-identity with profiling enabled
+# ==========================================================================
+@pytest.mark.parametrize("qnum", [1, 3])
+def test_tpch_fused_vs_unfused_bit_identical_with_profiler(qnum):
+    def rows(conf):
+        sess = srt.Session(conf)
+        tables = tpch_datagen.dataframes(sess, sf=SF, seed=SEED)
+        return tpch.QUERIES[qnum](tables).collect()
+
+    fused = rows(dict(PROF))
+    unfused = rows(dict(PROF, **{
+        "spark.rapids.tpu.sql.fusion.enabled": False}))
+    assert fused == unfused, f"q{qnum} diverged with profiler enabled"

@@ -370,3 +370,52 @@ def test_admission_reject_events_carry_depth_and_wait():
         spans.deactivate()
         sess.shutdown_scheduler()
         sess.close()
+
+
+# ==========================================================================
+# Latency histograms (queue-wait + per-tenant) in the export surface
+# ==========================================================================
+def test_latency_histograms_export_percentiles_and_prometheus():
+    sess = srt.Session()
+    try:
+        handles = [sess.submit(_select_df(sess), tenant=t)
+                   for t in ("gold", "bronze", "gold")]
+        for h in handles:
+            h.result(timeout=120)
+        em = sess.export_metrics()
+        # sliding-window percentile gauges for queue wait and for each
+        # tenant's end-to-end latency
+        for p in ("P50", "P95", "P99"):
+            assert f"scheduler.queueWait{p}Ms" in em, sorted(
+                k for k in em if "queueWait" in k)
+            assert f"scheduler.tenant.gold.latency{p}Ms" in em
+            assert f"scheduler.tenant.bronze.latency{p}Ms" in em
+        assert em["scheduler.tenant.gold.latencyP50Ms"] <= \
+            em["scheduler.tenant.gold.latencyP99Ms"]
+        # prometheus: proper histogram exposition with tenant labels
+        text = sess.metrics_text()
+        assert "# TYPE spark_rapids_tpu_queue_wait_ms histogram" in text
+        assert ("# TYPE spark_rapids_tpu_query_latency_ms histogram"
+                in text)
+        assert 'query_latency_ms_bucket{tenant="gold",le="+Inf"} 2' \
+            in text
+        assert 'query_latency_ms_count{tenant="bronze"} 1' in text
+        # the queue-wait histogram counted every dispatched query
+        import re as _re
+        m = _re.search(
+            r'spark_rapids_tpu_queue_wait_ms_count (\d+)', text)
+        assert m and int(m.group(1)) >= 3, text[-500:]
+    finally:
+        sess.shutdown_scheduler()
+        sess.close()
+
+
+def test_overload_monitor_p95_rides_the_histogram():
+    mon = OverloadMonitor(TpuConf({}), lambda: [], lambda: 0.0)
+    for _ in range(50):
+        mon.record_wait(10.0)
+    mon.record_wait(2000.0)
+    p95 = mon.wait_p95()
+    # p95 of 50x10ms + 1x2s sits in the 10ms bucket's neighborhood;
+    # bucketing may round up to the bucket bound, never down past it
+    assert 8.0 <= p95 <= 32.0, p95

@@ -526,3 +526,39 @@ def test_normalize_plan_text_erases_growing_counts():
     # but keys and operators still distinguish shapes
     t3 = t1.replace("k2", "k9")
     assert normalize_plan_text(t1) != normalize_plan_text(t3)
+
+
+# ==========================================================================
+# Batch-latency histogram in the export surface (ISSUE 13)
+# ==========================================================================
+def test_batch_latency_histogram_in_progress_and_prometheus(
+        li_table, tmp_path):
+    data = tmp_path / "lineitem"
+    cuts = _cuts(li_table, 3)
+    _write_chunk(data, li_table, cuts, 0)
+    sess = srt.Session(_conf(tmp_path / "rec"))
+    h = sess.stream(_tpch_query(sess, 1, data), trigger=0)
+    try:
+        h.process_available()
+        _write_chunk(data, li_table, cuts, 1)
+        h.process_available()
+        prog = h.progress()
+        for p in ("P50", "P95", "P99"):
+            assert f"streaming.batchLatency{p}Ms" in prog, sorted(prog)
+        assert prog["streaming.batchLatencyP50Ms"] <= \
+            prog["streaming.batchLatencyP99Ms"]
+        assert prog["streaming.batchLatencyP50Ms"] > 0
+        # live streams surface through the session's export/prometheus
+        # aggregation, one labeled histogram series per stream
+        em = sess.export_metrics()
+        assert any(k.startswith("streaming.batchLatency") for k in em)
+        text = sess.metrics_text()
+        assert ("# TYPE spark_rapids_tpu_stream_batch_latency_ms "
+                "histogram") in text
+        assert f'le="+Inf"}} 2' in text
+        assert f'stream="{h.stream_id}"' in text
+    finally:
+        h.stop()
+    # a stopped stream drops out of the aggregation
+    assert not any(k.startswith("streaming.")
+                   for k in sess.export_metrics())

@@ -442,7 +442,8 @@ def test_hbm_watermark_uses_peak_column():
 # ==========================================================================
 _PROM_LINE = re.compile(
     r'^spark_rapids_tpu_metric\{exec="[A-Za-z0-9_]*",'
-    r'name="[A-Za-z0-9_]+"(,query="[^"]+")?\} -?[0-9.e+-]+$')
+    r'name="[A-Za-z0-9_]+"(,(tenant|exchange)="[^"]+")?'
+    r'(,query="[^"]+")?\} -?[0-9.e+-]+$')
 
 
 def test_prometheus_export_format_and_stability():
@@ -462,6 +463,58 @@ def test_prometheus_export_format_and_stability():
     # counter families export with an empty exec label
     assert any('exec="",name="fault_degradeLevel"' in ln
                for ln in lines)
+
+
+def test_prometheus_tenant_and_exchange_labels():
+    metrics = {
+        "scheduler.tenant.alpha.finished": 3,
+        "scheduler.tenant.alpha.latencyP95Ms": 12.5,
+        "scheduler.tenant.beta-2.shed": 1,
+        "shuffle.exchange2.spillBytes": 4096,
+        "fault.degradeLevel": 0,
+    }
+    text = prometheus_text(metrics)
+    assert ('spark_rapids_tpu_metric{exec="",'
+            'name="scheduler_tenant_finished",tenant="alpha"} 3') in text
+    assert ('spark_rapids_tpu_metric{exec="",'
+            'name="scheduler_tenant_shed",tenant="beta-2"} 1') in text
+    assert ('spark_rapids_tpu_metric{exec="",'
+            'name="shuffle_exchange_spillBytes",exchange="2"} 4096') \
+        in text
+    # every line still matches the canonical grammar, and unlabeled
+    # families render exactly as before
+    lines = [ln for ln in text.splitlines()
+             if ln and not ln.startswith("#")]
+    for ln in lines:
+        assert _PROM_LINE.match(ln), ln
+    assert 'exec="",name="fault_degradeLevel"} 0' in text
+
+
+def test_prometheus_histogram_exposition():
+    from spark_rapids_tpu.telemetry.histogram import LatencyHistogram
+    h = LatencyHistogram(window_s=60.0)
+    for v in (0.5, 1.0, 2.0, 1000.0):
+        h.observe(v, now=100.0)
+    text = prometheus_text({}, histograms=[
+        ("queue_wait_ms", {}, h),
+        ("query_latency_ms", {"tenant": "alpha"}, h),
+    ])
+    assert "# TYPE spark_rapids_tpu_queue_wait_ms histogram" in text
+    assert "# TYPE spark_rapids_tpu_query_latency_ms histogram" in text
+    # cumulative buckets are monotone and the +Inf bucket equals _count
+    buckets = re.findall(
+        r'spark_rapids_tpu_queue_wait_ms_bucket\{le="([^"]+)"\} (\d+)',
+        text)
+    counts = [int(c) for _le, c in buckets]
+    assert counts == sorted(counts)
+    assert buckets[-1][0] == "+Inf" and counts[-1] == 4
+    assert "spark_rapids_tpu_queue_wait_ms_count 4" in text
+    assert "spark_rapids_tpu_queue_wait_ms_sum 1003.5" in text
+    # labeled series put the labels before le=
+    assert ('spark_rapids_tpu_query_latency_ms_bucket{tenant="alpha",'
+            'le="+Inf"} 4') in text
+    assert ('spark_rapids_tpu_query_latency_ms_count{tenant="alpha"} 4'
+            ) in text
 
 
 def test_json_snapshot_round_trips():

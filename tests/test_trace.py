@@ -1,0 +1,126 @@
+"""Chrome-trace / Perfetto timeline export (telemetry/trace.py).
+
+Contract under test (ISSUE 13): a finished query round-trips through
+``chrome_trace``/``write_trace`` into a document Perfetto loads —
+valid JSON, non-negative monotonic µs timestamps, every span of the
+profile present exactly once as a complete ("X") event, the HBM
+sampler surfaced as a counter ("C") track — and a concurrent 3-query
+scheduler run renders as three distinct process tracks.  Per-query
+auto-export is gated by ``telemetry.trace.dir`` and goes through the
+atomic fsio writer.
+"""
+import glob
+import json
+import os
+
+import numpy as np
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.plan import functions as F
+from spark_rapids_tpu.telemetry.trace import (chrome_trace, write_trace,
+                                              write_query_trace)
+
+TEL = {"spark.rapids.tpu.telemetry.enabled": True,
+       "spark.rapids.tpu.telemetry.sampleHbmMs": 5}
+
+
+def _agg_df(sess, n=4096):
+    rng = np.random.RandomState(7)
+    df = sess.create_dataframe({
+        "g": rng.randint(0, 16, n),
+        "v": (rng.rand(n) * 10).round(6)})
+    return df.group_by("g").agg(F.sum("v").alias("s"),
+                                F.count("v").alias("n"))
+
+
+def _span_count(sp):
+    return 1 + sum(_span_count(c) for c in sp.children)
+
+
+def test_trace_roundtrip_valid_monotonic_and_complete(tmp_path):
+    sess = srt.Session(dict(TEL))
+    _agg_df(sess).collect()
+    prof = sess.last_profile
+    path = write_trace(str(tmp_path / "t.json"), prof)
+    doc = json.loads(open(path).read())      # valid JSON on disk
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert evs
+    # timestamps/durations non-negative; ordering is metadata first,
+    # then non-decreasing ts (the exporter's documented sort)
+    for e in evs:
+        assert e["ts"] >= 0
+        assert e.get("dur", 0) >= 0
+    keys = [(0 if e["ph"] == "M" else 1, e["pid"], e["ts"]) for e in evs]
+    assert keys == sorted(keys)
+    # every span of the profile appears exactly once as an X event
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == _span_count(prof.root)
+    names = [e["name"] for e in xs]
+    assert f"query:{prof.query_id}" in names
+    assert any(n.startswith("exec:HostToDeviceExec") for n in names)
+    # the HBM sampler renders as a counter track
+    cs = [e for e in evs if e["ph"] == "C"]
+    assert cs and all(e["name"] == "HBM" for e in cs)
+    assert all(e["args"]["peak"] >= e["args"]["allocated"] >= 0
+               for e in cs)
+    # ring events render as instants; the begin/end pair is already
+    # delimited by the root span and must not double-render
+    inames = {e["name"] for e in evs if e["ph"] == "i"}
+    assert not inames & {"query_begin", "query_end"}
+    # process/thread naming metadata for Perfetto's track labels
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in evs)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in evs)
+
+
+def test_concurrent_scheduler_queries_get_distinct_tracks():
+    sess = srt.Session(dict(TEL))
+    handles = [sess.submit(_agg_df(sess)) for _ in range(3)]
+    for h in handles:
+        h.result(timeout=180)
+    profs = [h.profile for h in handles]
+    assert all(p is not None for p in profs)
+    doc = chrome_trace(profs)
+    evs = doc["traceEvents"]
+    # one pid per query, each with its own process_name metadata
+    pids = {e["pid"] for e in evs}
+    assert pids == {1, 2, 3}
+    pnames = {e["pid"]: e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert len(pnames) == 3
+    assert len(set(pnames.values())) == 3      # distinct query ids
+    # every pid carries its spans and an HBM counter track
+    for pid in (1, 2, 3):
+        assert any(e["ph"] == "X" and e["pid"] == pid for e in evs)
+        assert any(e["ph"] == "C" and e["pid"] == pid for e in evs)
+    # document is serializable as-is (what write_trace persists)
+    json.loads(json.dumps(doc))
+
+
+def test_trace_dir_conf_auto_exports_per_query(tmp_path):
+    td = str(tmp_path / "traces")
+    sess = srt.Session(dict(TEL, **{
+        "spark.rapids.tpu.telemetry.trace.dir": td}))
+    _agg_df(sess, n=256).collect()
+    _agg_df(sess, n=256).collect()
+    files = sorted(glob.glob(os.path.join(td, "trace-*.json")))
+    assert len(files) == 2
+    for f in files:
+        doc = json.load(open(f))
+        assert doc["traceEvents"]
+    # atomic writer: no temp files left behind
+    assert not glob.glob(os.path.join(td, ".srt-tmp-*"))
+    # exception-safety contract: no profile -> no file, no raise
+    assert write_query_trace(td, None) is None
+    assert write_query_trace("", sess.last_profile) is None
+
+
+def test_trace_export_off_by_default(tmp_path):
+    sess = srt.Session(dict(TEL))
+    _agg_df(sess, n=256).collect()
+    # no trace.dir conf -> nothing written anywhere under cwd/tmp
+    from spark_rapids_tpu.config import TELEMETRY_TRACE_DIR
+    assert sess.conf.get(TELEMETRY_TRACE_DIR) == ""
+    assert not glob.glob(str(tmp_path / "trace-*.json"))
