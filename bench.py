@@ -263,7 +263,7 @@ def _shuffle_microbench():
 
     * ``device`` — partition ids + the packed partition-build kernel;
       the block stays in HBM (zero host copies by construction, the
-      property tests/test_lint_shuffle.py pins at the AST level).
+      property the host-sync analysis rule pins at the AST level).
     * ``host``   — the staged path the device mode replaced: d2h of
       the whole batch, CRC32C stamp of every column frame, h2d
       promote.  The device/host GB/s ratio is the headline win.
@@ -743,13 +743,49 @@ def _atomic_write_json(path, obj) -> None:
     fsio.atomic_write_json(path, obj)
 
 
+#: memoized verdict of the static-analysis gate (None = not yet run)
+_ANALYSIS_GATE = None
+
+
+def _analysis_gate() -> bool:
+    """Whether artifacts may be persisted: the static-analysis engine
+    (docs/static_analysis.md) must report no new findings — a
+    measurement of a tree that violates the engine's own invariants is
+    not a baseline worth comparing future runs against.  Fails OPEN on
+    an engine crash: the gate protects artifact quality, it must never
+    be the thing that loses a run's evidence."""
+    global _ANALYSIS_GATE
+    if _ANALYSIS_GATE is None:
+        try:
+            from spark_rapids_tpu.analysis import (AnalysisContext,
+                                                   run_rules)
+            from spark_rapids_tpu.analysis.baseline import (
+                DEFAULT_BASELINE, Baseline)
+            findings = run_rules(AnalysisContext())
+            new, _supp, _stale = Baseline.load(
+                DEFAULT_BASELINE).split(findings)
+            if new:
+                _emit({"analysis_gate": "refused",
+                       "new_findings": len(new),
+                       "first": new[0].render(),
+                       "hint": "python -m spark_rapids_tpu.analysis"})
+            _ANALYSIS_GATE = not new
+        except Exception as e:  # noqa: BLE001 — gate fails open
+            _emit({"analysis_gate": "fail-open", "error": repr(e)})
+            _ANALYSIS_GATE = True
+    return _ANALYSIS_GATE
+
+
 def _persist_tpu_artifact(summary, path=None) -> None:
     """Committed last-good TPU evidence: a wedged tunnel at the NEXT
     capture must not erase this one (VERDICT r4 next-round #1c).
     Atomic (temp-file + rename): a probe failure or mid-write kill
-    keeps the previous last-known-good file."""
+    keeps the previous last-known-good file.  Refuses to write while
+    the static-analysis gate reports new findings."""
     import datetime
 
+    if not _analysis_gate():
+        return
     if path is None:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_TPU_LAST.json")

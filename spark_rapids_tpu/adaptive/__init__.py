@@ -15,7 +15,7 @@ Three pieces:
   aggregated from the count vectors the device shuffle's write drain
   already pulls to the host in its ONE gated readback
   (``exec/exchange.py``'s ``flush``).  Zero extra device syncs — this
-  module never imports jax (``tests/test_lint_adaptive.py`` enforces
+  module never imports jax (the ``jax-import`` analysis rule enforces
   it mechanically).
 * :mod:`.planner` — ``AdaptivePlanner``: the three rewrites applied to
   the unexecuted plan suffix between stages — partition coalescing,

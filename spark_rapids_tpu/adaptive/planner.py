@@ -9,7 +9,8 @@ their partitions are regrouped), CoalesceShufflePartitions last (it
 must not merge a partition skew just decided to split).
 
 Every rewrite function emits its structured ``aqe_*`` decision event —
-``tests/test_lint_adaptive.py`` enforces the pairing mechanically —
+the ``decision-event`` analysis rule enforces the pairing
+mechanically —
 and bumps an ``aqe.*`` int counter that rides ``Session.last_metrics``
 into bench.py and the Prometheus export.
 

@@ -16,7 +16,8 @@ exchange write drain ALREADY knows once a stage materializes:
 
 Everything in here is host-side numpy on numbers that were already
 host-resident: this module MUST NOT import jax or call any host-sync
-primitive — ``tests/test_lint_adaptive.py`` enforces both, which is
+primitive — the ``jax-import`` and ``host-sync`` analysis rules
+enforce both, which is
 how "zero added device syncs on the shuffle write path" stays true as
 the code evolves.
 """

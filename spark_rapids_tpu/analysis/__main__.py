@@ -1,0 +1,7 @@
+"""``python -m spark_rapids_tpu.analysis`` entry point."""
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""Baseline suppression file.
+
+The baseline records findings audited as *intentional* — each entry
+carries a one-line justification.  Matching is by the finding's
+line-number-free fingerprint, so entries survive unrelated edits.
+
+Semantics:
+
+* a finding whose fingerprint is in the baseline is **suppressed**;
+* a finding not in the baseline is **new** (CLI exits 1);
+* a baseline entry matching no current finding is **stale** (reported
+  as a warning; ``--update-baseline`` drops it).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from .findings import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+VERSION = 1
+
+
+class Baseline:
+    def __init__(self, entries: List[Dict[str, str]]):
+        #: fingerprint -> entry dict
+        self.entries: Dict[str, Dict[str, str]] = {
+            e["fingerprint"]: e for e in entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.isfile(path):
+            return cls([])
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != VERSION:
+            raise ValueError(
+                f"baseline {path}: unsupported version "
+                f"{data.get('version')!r} (expected {VERSION})")
+        return cls(data.get("entries", []))
+
+    def split(self, findings: Iterable[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[Dict[str, str]]]:
+        """Partition into (new, suppressed, stale-entries)."""
+        new: List[Finding] = []
+        suppressed: List[Finding] = []
+        seen = set()
+        for f in findings:
+            fp = f.fingerprint
+            if fp in self.entries:
+                suppressed.append(f)
+                seen.add(fp)
+            else:
+                new.append(f)
+        stale = [e for fp, e in sorted(self.entries.items())
+                 if fp not in seen]
+        return new, suppressed, stale
+
+    def updated(self, findings: Iterable[Finding]) -> Dict:
+        """A serializable baseline covering exactly the current
+        findings; justifications of kept entries are preserved, new
+        entries get a fill-me-in marker the committer must edit."""
+        entries = []
+        done = set()
+        for f in findings:
+            fp = f.fingerprint
+            if fp in done:
+                continue
+            done.add(fp)
+            old = self.entries.get(fp)
+            entries.append({
+                "fingerprint": fp,
+                "rule": f.rule,
+                "kind": f.kind,
+                "file": f.file,
+                "detail": f.detail or f.message,
+                "justification": (old or {}).get(
+                    "justification", "TODO: justify this suppression"),
+            })
+        entries.sort(key=lambda e: (e["rule"], e["file"], e["detail"]))
+        return {"version": VERSION, "entries": entries}
+
+    @staticmethod
+    def write(path: str, data: Dict) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)

@@ -1,0 +1,111 @@
+"""Command-line entry point: ``python -m spark_rapids_tpu.analysis``.
+
+Exit codes: 0 clean (or only baselined findings), 1 new findings,
+2 usage/internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from .baseline import DEFAULT_BASELINE, Baseline
+from .engine import AnalysisContext, all_rules, run_rules
+from .findings import Finding
+from .project import Project
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_ERROR = 2
+
+
+def _render_json(new: List[Finding], suppressed: List[Finding],
+                 stale) -> str:
+    def enc(f: Finding):
+        return {"rule": f.rule, "kind": f.kind, "file": f.file,
+                "line": f.line, "severity": f.severity,
+                "message": f.message, "detail": f.detail,
+                "fingerprint": f.fingerprint}
+    return json.dumps({"new": [enc(f) for f in new],
+                       "suppressed": [enc(f) for f in suppressed],
+                       "stale_baseline_entries": stale}, indent=2)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.analysis",
+        description="tpulint: whole-program static analysis "
+                    "(see docs/static_analysis.md)")
+    ap.add_argument("--rule", action="append", dest="rules",
+                    metavar="ID", help="run only this rule "
+                    "(repeatable); default: all rules")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="baseline suppression file "
+                    "(default: analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to cover current "
+                    "findings (preserves existing justifications)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: autodetect)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list rule ids and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in all_rules():
+            print(f"{cls.id:<18} {cls.title}")
+        return EXIT_CLEAN
+
+    t0 = time.monotonic()
+    try:
+        ctx = AnalysisContext(Project(args.root))
+        findings = run_rules(ctx, args.rules)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return EXIT_ERROR
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.no_baseline:
+        baseline = Baseline([])
+    else:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return EXIT_ERROR
+
+    if args.update_baseline:
+        data = baseline.updated(findings)
+        Baseline.write(baseline_path, data)
+        todo = sum(1 for e in data["entries"]
+                   if e["justification"].startswith("TODO"))
+        print(f"baseline written: {baseline_path} "
+              f"({len(data['entries'])} entries, {todo} need "
+              f"justification)")
+        return EXIT_CLEAN
+
+    new, suppressed, stale = baseline.split(findings)
+
+    if args.as_json:
+        print(_render_json(new, suppressed, stale))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"{e['file']}: [{e['rule']}/{e['kind']}] warning: "
+                  f"stale baseline entry (no longer found): "
+                  f"{e['detail']}")
+        dt = time.monotonic() - t0
+        n_rules = len(args.rules) if args.rules else len(all_rules())
+        print(f"tpulint: {len(ctx.project.files())} files, "
+              f"{n_rules} rules, {len(new)} new finding(s), "
+              f"{len(suppressed)} baselined, {len(stale)} stale "
+              f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
+              f"[{dt:.2f}s]")
+    return EXIT_FINDINGS if new else EXIT_CLEAN

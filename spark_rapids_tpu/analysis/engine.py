@@ -1,0 +1,108 @@
+"""Rule plugin API, registry, and the engine driver.
+
+A rule subclasses :class:`Rule`, names itself (``id``/``kind`` slugs
+appear in every finding), and implements :meth:`Rule.run` against the
+shared :class:`AnalysisContext` (one project, one resolver — parsed
+once, shared by all rules).  Rules self-register at import; the rule
+catalog lives in :mod:`spark_rapids_tpu.analysis.rules` and is
+documented in ``docs/static_analysis.md``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Type
+
+from .findings import Finding
+from .project import Project
+from .resolver import Resolver
+
+_REGISTRY: Dict[str, Type["Rule"]] = {}
+
+
+class AnalysisContext:
+    """Shared per-run state handed to every rule."""
+
+    def __init__(self, project: Optional[Project] = None):
+        self.project = project or Project()
+        self.resolver = Resolver(self.project)
+
+
+class Rule:
+    """Base class for analysis rules.
+
+    Subclasses set ``id`` (the rule slug used in findings, the CLI
+    ``--rule`` filter, and baseline entries) and ``title``, then
+    implement :meth:`run`.  Definition order is registration order;
+    the engine runs rules sorted by id for stable output.
+    """
+
+    id: str = ""
+    title: str = ""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if cls.id:
+            _REGISTRY[cls.id] = cls
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # -- helpers -------------------------------------------------------
+    def finding(self, kind: str, file: str, line: int, message: str,
+                detail: str = "", severity: str = "error") -> Finding:
+        return Finding(rule=self.id, kind=kind, file=file, line=line,
+                       message=message, detail=detail, severity=severity)
+
+    def health(self, ok: bool, file: str, message: str,
+               detail: str = "") -> List[Finding]:
+        """Self-check: a rule that matched nothing is a broken rule,
+        not a clean tree.  Emits a kind=health finding when ``ok`` is
+        false (the old lints' ``checked >= N`` asserts)."""
+        if ok:
+            return []
+        return [self.finding("health", file, 0,
+                             f"rule self-check failed: {message}",
+                             detail=detail or message)]
+
+
+def _ensure_rules_loaded() -> None:
+    # import for registration side effect; deferred so engine.py can be
+    # imported by rule modules without a cycle
+    from . import rules  # noqa: F401
+
+
+def all_rules() -> List[Type[Rule]]:
+    _ensure_rules_loaded()
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id: str) -> Type[Rule]:
+    _ensure_rules_loaded()
+    try:
+        return _REGISTRY[rule_id]
+    except KeyError:
+        known = ", ".join(sorted(_REGISTRY))
+        raise KeyError(f"unknown rule {rule_id!r}; known: {known}")
+
+
+def run_rules(ctx: Optional[AnalysisContext] = None,
+              rule_ids: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected rules (default: all) and return findings sorted
+    by (file, line, rule, kind).  Files that fail to parse surface as
+    ``engine/parse-error`` findings so they can never silently drop out
+    of every rule's scope."""
+    ctx = ctx or AnalysisContext()
+    classes = ([get_rule(r) for r in rule_ids] if rule_ids
+               else all_rules())
+    findings: List[Finding] = []
+    for cls in classes:
+        findings.extend(cls().run(ctx))
+    for rel in ctx.project.files():
+        ctx.project.tree(rel)  # force parse so errors are complete
+    for rel, err in sorted(ctx.project.parse_errors.items()):
+        findings.append(Finding(rule="engine", kind="parse-error",
+                                file=rel, line=0,
+                                message=f"file does not parse: {err}",
+                                detail=err))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.kind,
+                                 f.message))
+    return findings

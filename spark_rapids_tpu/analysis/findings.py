@@ -1,0 +1,51 @@
+"""Typed findings.
+
+A :class:`Finding` is one rule violation at one source location.  Its
+*fingerprint* — the baseline-suppression identity — deliberately
+excludes the line number: a finding must survive unrelated edits above
+it, so identity is ``rule|kind|file|detail`` where ``detail`` is a
+stable semantic handle (usually ``function(): offending-name``).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.
+
+    ``rule``      rule id (see docs/static_analysis.md)
+    ``kind``      sub-check slug within the rule (stable, test-filterable)
+    ``file``      repo-root-relative posix path
+    ``line``      1-based line (0 = whole-file/whole-project finding)
+    ``message``   human-readable description
+    ``detail``    stable identity used for the fingerprint (defaults to
+                  the message)
+    ``severity``  ``error`` gates; ``warning`` reports only
+    """
+
+    rule: str
+    kind: str
+    file: str
+    line: int
+    message: str
+    detail: str = ""
+    severity: str = field(default=Severity.ERROR)
+
+    @property
+    def fingerprint(self) -> str:
+        ident = self.detail or self.message
+        raw = f"{self.rule}|{self.kind}|{self.file}|{ident}"
+        return hashlib.md5(raw.encode()).hexdigest()[:12]
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        return f"{loc}: [{self.rule}/{self.kind}] {self.severity}: " \
+               f"{self.message}"

@@ -1,0 +1,200 @@
+"""Per-module symbol/import resolver and call/attribute index.
+
+The shared infrastructure every rule used to rebuild privately: for
+each module, the function table (with *own-body* call lists — nested
+defs own their bodies, the discipline the old lints converged on), the
+import alias map, module-level assignments, class table, thread-spawn
+sites and ``with``-acquired locks.  Cross-module call resolution is
+*name-based and conservative*: a call resolves to the functions of the
+same terminal name, preferring same-module definitions — precise
+enough for reachability/lock analysis over this codebase's idiom,
+cheap enough to run on every tier-1 invocation.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .project import Project
+
+#: call names never worth resolving cross-module (builtins/collection
+#: traffic) — keeps the name-based call graph from inventing edges
+UNRESOLVED_NAMES = frozenset({
+    "get", "put", "pop", "append", "add", "discard", "remove", "clear",
+    "extend", "update", "items", "keys", "values", "setdefault", "set",
+    "join", "start", "wait", "notify", "notify_all", "cancel", "close",
+    "len", "int", "float", "str", "bool", "list", "dict", "tuple",
+    "isinstance", "getattr", "setattr", "hasattr", "print", "range",
+    "sorted", "min", "max", "sum", "abs", "round", "repr", "open",
+    "copy", "format", "split", "strip", "encode", "decode", "read",
+    "write", "snapshot", "info", "warning", "error", "debug",
+})
+
+
+def terminal_name(func: ast.AST) -> str:
+    """The rightmost name of a call target: ``a.b.c(...)`` -> ``c``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted rendering of a Name/Attribute chain
+    (``self._dm.semaphore`` -> ``"self._dm.semaphore"``); empty string
+    for anything not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def own_body_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body with nested function defs excluded — a
+    nested def owns its body (gated inner functions must not taint
+    their parent, and vice versa)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class FuncInfo:
+    """One function/method definition and its own-body call index."""
+
+    __slots__ = ("module", "qualname", "name", "node", "lineno",
+                 "own_calls", "own_call_names", "class_name")
+
+    def __init__(self, module: str, qualname: str, node,
+                 class_name: Optional[str]):
+        self.module = module
+        self.qualname = qualname
+        self.name = node.name
+        self.node = node
+        self.lineno = node.lineno
+        self.class_name = class_name
+        self.own_calls: List[ast.Call] = [
+            n for n in own_body_nodes(node) if isinstance(n, ast.Call)]
+        self.own_call_names: Set[str] = {
+            terminal_name(c.func) for c in self.own_calls}
+
+    def all_calls(self) -> List[ast.Call]:
+        """Every call under the def, nested functions included."""
+        return [n for n in ast.walk(self.node)
+                if isinstance(n, ast.Call)]
+
+
+class ModuleIndex:
+    """Function/class/import/global index of one parsed module."""
+
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.tree = tree
+        self.functions: List[FuncInfo] = []
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        #: module import names: alias -> imported module/symbol path
+        self.imports: Dict[str, str] = {}
+        #: names assigned at module (or class) level -> the value node
+        self.module_assigns: Dict[str, ast.AST] = {}
+        self._index()
+
+    def _index(self) -> None:
+        def visit(node, qual: str, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    fi = FuncInfo(self.rel, q, child, cls)
+                    self.functions.append(fi)
+                    self.by_name.setdefault(child.name, []).append(fi)
+                    visit(child, q, cls)
+                elif isinstance(child, ast.ClassDef):
+                    self.classes[child.name] = child
+                    q = f"{qual}.{child.name}" if qual \
+                        else child.name
+                    visit(child, q, child.name)
+                else:
+                    visit(child, qual, cls)
+
+        visit(self.tree, "", None)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    self.imports[a.asname or a.name] = \
+                        f"{node.module or ''}.{a.name}"
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_assigns[t.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    self.module_assigns[stmt.target.id] = stmt.value
+
+    def imported_modules(self) -> Iterable[Tuple[str, int]]:
+        """Yield (module-path, lineno) for every import statement."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    yield a.name, node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                yield node.module or "", node.lineno
+
+
+class Resolver:
+    """Cached :class:`ModuleIndex` per file plus conservative
+    cross-module call resolution."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._modules: Dict[str, Optional[ModuleIndex]] = {}
+
+    def module(self, rel: str) -> Optional[ModuleIndex]:
+        if rel not in self._modules:
+            tree = self.project.tree(rel)
+            self._modules[rel] = \
+                ModuleIndex(rel, tree) if tree is not None else None
+        return self._modules[rel]
+
+    def modules(self, rels: Iterable[str]) -> List[ModuleIndex]:
+        out = []
+        for rel in rels:
+            mi = self.module(rel)
+            if mi is not None:
+                out.append(mi)
+        return out
+
+    def functions(self, rels: Iterable[str]) -> List[FuncInfo]:
+        out: List[FuncInfo] = []
+        for mi in self.modules(rels):
+            out.extend(mi.functions)
+        return out
+
+    def resolve_call(self, caller: FuncInfo, call: ast.Call,
+                     scope: List[ModuleIndex]) -> List[FuncInfo]:
+        """Candidate callees of ``call`` within ``scope``: same-module
+        definitions of the terminal name win; otherwise cross-module
+        definitions, but only when the name is not a generic
+        collection/builtin name and is defined somewhere in scope."""
+        name = terminal_name(call.func)
+        if not name or name in UNRESOLVED_NAMES:
+            return []
+        own = self.module(caller.module)
+        if own is not None and name in own.by_name:
+            return own.by_name[name]
+        out: List[FuncInfo] = []
+        for mi in scope:
+            out.extend(mi.by_name.get(name, ()))
+        return out

@@ -1,0 +1,18 @@
+"""The rule catalog.
+
+Importing this package registers every rule with the engine (see
+``engine.all_rules``).  Rule ids, scopes and semantics are documented
+in ``docs/static_analysis.md``; each module groups the rules of one
+invariant family.
+"""
+from . import (  # noqa: F401  (imported for registration side effect)
+    cancellation,
+    compile_path,
+    drift,
+    durability,
+    host_sync,
+    imports_rule,
+    locks,
+    resources,
+    telemetry_rules,
+)

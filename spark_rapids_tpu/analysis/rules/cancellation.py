@@ -1,0 +1,136 @@
+"""Cancellation rules: cancel-poll, collective-cancel.
+
+Long-running drain loops must hit a cancellation/fault checkpoint per
+iteration (``check_cancel`` raises on a cancelled token; the injector
+checkpoints double as poll points), streaming daemon loops must watch
+their stop signal, and the collective exchange must poll before every
+blocking collective so one cancelled participant cannot wedge the
+mesh.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..engine import AnalysisContext, Rule
+from ..findings import Finding
+from ..resolver import own_body_nodes, terminal_name
+from . import common
+
+#: any of these in a loop body counts as a poll point
+POLL_NAMES = frozenset({"check_cancel", "maybe_inject_fault",
+                        "maybe_inject_oom"})
+
+#: names a streaming daemon loop may watch instead (stop-signal idiom)
+STREAM_POLL_NAMES = frozenset({"check_cancel", "cancelled", "wait"})
+
+DRAIN_SCOPE_PREFIXES = ("exec/",)
+DRAIN_SCOPE_FILES = ("parallel/runner.py", "parallel/multiprocess.py")
+
+
+def _is_drain_loop(loop: ast.While) -> bool:
+    if isinstance(loop.test, ast.Constant) and loop.test.value is True:
+        return True
+    for n in ast.walk(loop):
+        if isinstance(n, ast.Call) and \
+                terminal_name(n.func) in ("get", "put"):
+            return True
+    return False
+
+
+def _loop_polls(loop: ast.AST, names: frozenset) -> bool:
+    for n in ast.walk(loop):
+        if isinstance(n, ast.Call) and terminal_name(n.func) in names:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in names:
+            return True
+        if isinstance(n, ast.Name) and (
+                n.id in names or n.id.startswith("_stop")):
+            return True
+    return False
+
+
+class CancelPollRule(Rule):
+    id = "cancel-poll"
+    title = "drain/daemon loops poll a cancellation checkpoint"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = common.scoped(ctx, prefixes=DRAIN_SCOPE_PREFIXES,
+                             files=DRAIN_SCOPE_FILES)
+        checked = 0
+        for fi in ctx.resolver.functions(rels):
+            for n in own_body_nodes(fi.node):
+                if isinstance(n, ast.While) and _is_drain_loop(n):
+                    checked += 1
+                    if not _loop_polls(n, POLL_NAMES):
+                        out.append(self.finding(
+                            "drain-loop", fi.module, n.lineno,
+                            f"drain loop in {fi.qualname}() never "
+                            f"polls {sorted(POLL_NAMES)} — a "
+                            f"cancelled query cannot unwind it",
+                            detail=f"{fi.qualname}:drain-loop"))
+        out.extend(self.health(
+            checked >= 3, common.PKG + "exec",
+            f"expected >=3 drain loops in scope, saw {checked}"))
+
+        # streaming daemons: every while loop watches its stop signal
+        stream_loops = 0
+        for fi in ctx.resolver.functions(
+                common.scoped(ctx, prefixes=("streaming/",))):
+            for n in own_body_nodes(fi.node):
+                if isinstance(n, ast.While):
+                    stream_loops += 1
+                    if not _loop_polls(n, STREAM_POLL_NAMES):
+                        out.append(self.finding(
+                            "stream-loop", fi.module, n.lineno,
+                            f"streaming loop in {fi.qualname}() "
+                            f"never consults its stop signal "
+                            f"(check_cancel/cancelled/wait/_stop*)",
+                            detail=f"{fi.qualname}:stream-loop"))
+        out.extend(self.health(
+            stream_loops >= 2, common.PKG + "streaming",
+            f"expected >=2 streaming daemon loops, saw {stream_loops}"))
+        return out
+
+
+class CollectiveCancelRule(Rule):
+    id = "collective-cancel"
+    title = "collectives poll cancellation before blocking the mesh"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = common.scoped(ctx, prefixes=("parallel/",))
+        # the exchange step itself
+        steps = [fi for fi in ctx.resolver.functions(rels)
+                 if fi.name == "exchange_step"]
+        for fi in steps:
+            # the poll lives in the returned dispatch closure — check
+            # the whole subtree, nested defs included
+            if not any(terminal_name(c.func) == "check_cancel"
+                       for c in fi.all_calls()):
+                out.append(self.finding(
+                    "exchange-step", fi.module, fi.lineno,
+                    "exchange_step() must check_cancel before the "
+                    "collective — one cancelled participant would "
+                    "wedge every peer",
+                    detail="exchange_step:check_cancel"))
+        out.extend(self.health(
+            len(steps) == 1, common.PKG + "parallel/exchange.py",
+            f"expected exactly one exchange_step, saw {len(steps)}"))
+        # every allgather dispatcher polls
+        checked = 0
+        for fi in ctx.resolver.functions(rels):
+            if "process_allgather" in fi.own_call_names:
+                checked += 1
+                if "check_cancel" not in fi.own_call_names:
+                    out.append(self.finding(
+                        "allgather", fi.module, fi.lineno,
+                        f"{fi.qualname}() dispatches "
+                        f"process_allgather without check_cancel",
+                        detail=f"{fi.qualname}:allgather"))
+        out.extend(self.health(
+            checked >= 2, common.PKG + "parallel",
+            f"expected >=2 process_allgather dispatchers, "
+            f"saw {checked}"))
+        return out

@@ -1,0 +1,165 @@
+"""Durability rules: atomic-write, crc-verify, no-deserialize,
+manifest-fingerprint.
+
+The recovery substrate's correctness story is torn-write-free
+persistence (fsio atomic helpers), verify-before-deserialize (CRC
+precedes any frame decode), and manifest consumption keyed by the
+plan fingerprint so a recovered stage can never feed a different
+plan's data.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import ast
+
+from ..engine import AnalysisContext, Rule
+from ..findings import Finding
+from ..resolver import terminal_name
+from . import common
+
+ATOMIC_HELPERS = frozenset({"atomic_write_bytes", "atomic_write_json"})
+
+#: durable-state scope: everything here persists across crashes
+DURABLE_PREFIXES = ("recovery/", "streaming/")
+DURABLE_FILES = ("memory/spill.py",)
+
+#: minimum atomic-helper call counts per file (the load-bearing
+#: persistence points must stay on the atomic path)
+ATOMIC_MINIMUMS = (("recovery/store.py", 2), ("memory/spill.py", 1),
+                   ("streaming/ledger.py", 1))
+
+WRITE_MODES = set("wax+")
+
+
+def _is_write_open(call: ast.Call) -> bool:
+    if terminal_name(call.func) != "open":
+        return False
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return bool(set(mode.value) & WRITE_MODES)
+    return False
+
+
+class AtomicWriteRule(Rule):
+    id = "atomic-write"
+    title = "durable state is written only through fsio atomic helpers"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = common.scoped(ctx, prefixes=DURABLE_PREFIXES,
+                             files=DURABLE_FILES)
+        calls_checked = 0
+        helper_counts = {rel: 0 for rel, _n in ATOMIC_MINIMUMS}
+        for fi in ctx.resolver.functions(rels):
+            for call in fi.all_calls():
+                calls_checked += 1
+                name = terminal_name(call.func)
+                suffix = next((rel for rel, _n in ATOMIC_MINIMUMS
+                               if fi.module.endswith(rel)), None)
+                if name in ATOMIC_HELPERS and suffix is not None:
+                    helper_counts[suffix] += 1
+                if _is_write_open(call):
+                    out.append(self.finding(
+                        "direct-write", fi.module, call.lineno,
+                        f"{fi.qualname}() opens a file for writing "
+                        f"directly — durable state goes through "
+                        f"{sorted(ATOMIC_HELPERS)} (torn-write-free)",
+                        detail=f"{fi.qualname}:open-write"))
+                elif name == "tofile":
+                    out.append(self.finding(
+                        "direct-write", fi.module, call.lineno,
+                        f"{fi.qualname}() uses ndarray.tofile() — "
+                        f"not atomic; route through fsio",
+                        detail=f"{fi.qualname}:tofile"))
+        for rel, minimum in ATOMIC_MINIMUMS:
+            out.extend(self.health(
+                helper_counts[rel] >= minimum, common.PKG + rel,
+                f"expected >={minimum} atomic-helper calls in {rel}, "
+                f"saw {helper_counts[rel]}"))
+        out.extend(self.health(
+            calls_checked >= 80, common.PKG + "recovery",
+            f"expected >=80 calls scanned in the durable scope, "
+            f"saw {calls_checked}"))
+        return out
+
+
+class CrcVerifyRule(Rule):
+    id = "crc-verify"
+    title = "frame readers verify CRC before deserializing"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = common.scoped(ctx, prefixes=("recovery/",),
+                             files=("memory/spill.py",))
+        readers = 0
+        for fi in ctx.resolver.functions(rels):
+            if "fromfile" in fi.own_call_names or \
+                    "frombuffer" in fi.own_call_names:
+                readers += 1
+                if "verify_frame" not in fi.own_call_names:
+                    out.append(self.finding(
+                        "unverified-read", fi.module, fi.lineno,
+                        f"{fi.qualname}() reads raw frames without "
+                        f"verify_frame — corrupt payloads must be "
+                        f"caught before deserialization",
+                        detail=f"{fi.qualname}:verify_frame"))
+        out.extend(self.health(
+            readers >= 1, common.PKG + "recovery",
+            f"expected >=1 raw frame reader, saw {readers}"))
+        return out
+
+
+class NoDeserializeRule(Rule):
+    id = "no-deserialize"
+    title = "recovery/ never decodes payloads itself"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fi in ctx.resolver.functions(
+                common.scoped(ctx, prefixes=("recovery/",))):
+            for call in fi.own_calls:
+                if terminal_name(call.func) == "deserialize":
+                    out.append(self.finding(
+                        "decode", fi.module, call.lineno,
+                        f"{fi.qualname}() calls deserialize() — "
+                        f"recovery hands verified bytes to the "
+                        f"native serializer's caller, it never "
+                        f"decodes payloads itself",
+                        detail=f"{fi.qualname}:deserialize"))
+        return out
+
+
+class ManifestFingerprintRule(Rule):
+    id = "manifest-fingerprint"
+    title = "manifest consumers key on plan_fingerprint"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rel = common.PKG + "recovery/manager.py"
+        mi = ctx.resolver.module(rel)
+        if mi is None:
+            return [self.finding("health", rel, 0,
+                                 "recovery/manager.py missing")]
+        consumers = 0
+        for fi in mi.functions:
+            if "read_manifest" in fi.own_call_names:
+                consumers += 1
+                if "plan_fingerprint" not in \
+                        common.string_literals(fi.node):
+                    out.append(self.finding(
+                        "unkeyed-consumer", rel, fi.lineno,
+                        f"{fi.qualname}() consumes a manifest "
+                        f"without checking plan_fingerprint — a "
+                        f"recovered stage could feed a different "
+                        f"plan's data",
+                        detail=f"{fi.qualname}:plan_fingerprint"))
+        out.extend(self.health(
+            consumers >= 1, rel,
+            f"expected >=1 read_manifest consumer, saw {consumers}"))
+        return out

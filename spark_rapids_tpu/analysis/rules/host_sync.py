@@ -1,0 +1,129 @@
+"""host-sync — no device-synchronizing calls in compute paths.
+
+The whole-program generalization of the old adaptive/shuffle/profiler
+sync lints: any call that forces a device->host transfer (and thus a
+pipeline stall) is banned across ``exec/``, ``ops/``, ``shuffle/``,
+``adaptive/`` and the profiler/kernel-cache dispatch path, except
+inside the small set of *gated* functions that implement the audited
+one-sync-per-K-batches pattern, and except in the files that ARE the
+host boundary by design (``exec/transitions.py`` — the d2h exec — and
+the CPU-fallback/host-sink operators).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..engine import AnalysisContext, Rule
+from ..findings import Finding
+from ..resolver import own_body_nodes, terminal_name
+from . import common
+
+#: attribute/function names that force a device sync wherever they run
+SYNC_NAMES = frozenset({
+    "device_get", "tolist", "item", "device_to_host", "to_host",
+    "block_until_ready",
+})
+
+#: functions implementing the audited one-sync-per-K gather pattern:
+#: their bodies are the *intended* sync points (nested defs own their
+#: bodies, so a gated inner function never exempts its parent)
+GATED_FUNCS = frozenset({
+    "fetch_counts", "flush", "drain_outs", "_maybe_checkpoint",
+})
+
+#: whole files that are host boundaries by design
+ALLOW_FILES = {
+    "exec/transitions.py":
+        "the audited d2h/h2d boundary exec — syncs are its job",
+    "exec/window_cpu.py":
+        "explicit CPU-fallback operator; host-side by design",
+    "exec/write.py":
+        "host filesystem sink; drains to host by contract",
+    "shuffle/partitioning.py":
+        "host-side range-bound sampling and row partitioning — "
+        "operates on HostBatch/np arrays, never on device values",
+}
+
+#: host-path naming convention: the CPU-fallback mirror of a device
+#: op (eval_cpu/do_cpu) and pure-numpy helpers (*_np) run on host
+#: data by contract — syncs there are not device stalls
+HOST_PATH_SUFFIXES = ("_cpu", "_np")
+
+#: np-rooted names whose ``asarray`` forces a transfer (jnp.asarray is
+#: a device-side placement and stays legal)
+NP_ROOTS = frozenset({"np", "numpy", "onp"})
+
+#: extra-strict files where even a bare ``asarray`` is banned (the
+#: profiler must never perturb what it measures)
+STRICT_FILES = ("telemetry/profiler.py", "exec/kernel_cache.py")
+
+#: files where ``np.asarray`` specifically is tolerated — AQE stats
+#: run on already-fetched host arrays (the old adaptive lint's carve
+#: out); the SYNC_NAMES ban still applies there
+NP_ASARRAY_EXEMPT = ("adaptive/stats.py",)
+
+
+def _np_asarray(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "asarray":
+        root = f.value
+        return isinstance(root, ast.Name) and root.id in NP_ROOTS
+    return False
+
+
+def _jnp_rooted(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in ("jnp", "jax"):
+            return True
+    return False
+
+
+class HostSyncRule(Rule):
+    id = "host-sync"
+    title = "no device-sync calls in compute paths"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = common.scoped(
+            ctx,
+            prefixes=("exec/", "ops/", "shuffle/", "adaptive/"),
+            files=STRICT_FILES,
+            exclude=tuple(ALLOW_FILES))
+        funcs_checked = 0
+        for fi in ctx.resolver.functions(rels):
+            if fi.name in GATED_FUNCS or \
+                    fi.name.endswith(HOST_PATH_SUFFIXES):
+                continue
+            funcs_checked += 1
+            strict = fi.module.endswith(STRICT_FILES)
+            for call in fi.own_calls:
+                name = terminal_name(call.func)
+                sync = name in SYNC_NAMES
+                if not sync and name == "asarray" and \
+                        not fi.module.endswith(NP_ASARRAY_EXEMPT):
+                    sync = strict or _np_asarray(call)
+                if sync:
+                    out.append(self.finding(
+                        "sync-call", fi.module, call.lineno,
+                        f"{fi.qualname}() calls {name}() — forces a "
+                        f"device sync on a compute path (gate it "
+                        f"behind one of {sorted(GATED_FUNCS)} or fix)",
+                        detail=f"{fi.qualname}:{name}"))
+                elif isinstance(call.func, ast.Name) and \
+                        call.func.id in ("float", "int") and \
+                        len(call.args) == 1 and \
+                        _jnp_rooted(call.args[0]):
+                    out.append(self.finding(
+                        "scalar-coerce", fi.module, call.lineno,
+                        f"{fi.qualname}() coerces a device value with "
+                        f"{call.func.id}() — blocks on the device",
+                        detail=f"{fi.qualname}:{call.func.id}"))
+        out.extend(self.health(
+            funcs_checked >= 50, common.PKG + "exec",
+            f"expected >=50 compute-path functions in scope, "
+            f"saw {funcs_checked}"))
+        out.extend(self.health(
+            len(rels) >= 15, common.PKG + "exec",
+            f"expected >=15 files in scope, saw {len(rels)}"))
+        return out

@@ -1,0 +1,42 @@
+"""jax-import — device-independent layers stay device-independent.
+
+``adaptive/`` (host-side planning), ``recovery/`` (must load in a
+fresh process before any device exists) and ``streaming/`` (daemon
+control plane) must never import jax at module level or lazily — the
+exec layer owns every device interaction.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..engine import AnalysisContext, Rule
+from ..findings import Finding
+from . import common
+
+BANNED_PREFIXES = ("adaptive/", "recovery/", "streaming/")
+
+
+class JaxImportRule(Rule):
+    id = "jax-import"
+    title = "host-side layers (adaptive/recovery/streaming) never import jax"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = common.scoped(ctx, prefixes=BANNED_PREFIXES)
+        for rel in rels:
+            mi = ctx.resolver.module(rel)
+            if mi is None:
+                continue
+            for mod, lineno in mi.imported_modules():
+                if mod == "jax" or mod.startswith("jax."):
+                    out.append(self.finding(
+                        "device-import", rel, lineno,
+                        f"imports {mod} — this layer is host-side by "
+                        f"contract; device interaction belongs to "
+                        f"exec/",
+                        detail=f"import:{mod}"))
+        out.extend(self.health(
+            len(rels) >= 8, common.PKG + "adaptive",
+            f"expected >=8 files in the host-side scope, "
+            f"saw {len(rels)}"))
+        return out

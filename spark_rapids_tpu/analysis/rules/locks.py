@@ -1,0 +1,277 @@
+"""lock-order / race-global — concurrency structure rules.
+
+``lock-order`` builds the lock-acquisition graph across the
+process-global singletons (scheduler, KernelCache, KernelProfiler,
+CheckpointStore): an edge A->B means some function acquires B (itself
+or via a call chain) while holding A.  A cycle in that graph is a
+potential deadlock between threads taking the locks in opposite
+orders.
+
+``race-global`` flags module-level mutable containers mutated from a
+function reachable from a thread-spawn site with no lock held — the
+class of bug the pin registry and profiler stats are one forgotten
+``with`` away from.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..engine import AnalysisContext, Rule
+from ..findings import Finding
+from ..resolver import FuncInfo, ModuleIndex, own_body_nodes, terminal_name
+from . import common
+
+#: the concurrency-critical scope: every file owning a process-global
+#: lock that another layer can call into
+SCOPE_PREFIXES = ("scheduler/",)
+SCOPE_FILES = ("exec/kernel_cache.py", "telemetry/profiler.py",
+               "recovery/store.py", "memory/device_manager.py",
+               "memory/semaphore.py")
+
+#: container constructors that make a module-level name mutable state
+MUTABLE_CALLS = frozenset({
+    "dict", "list", "set", "OrderedDict", "defaultdict", "deque",
+    "WeakValueDictionary", "WeakSet", "Counter",
+})
+
+#: method names that mutate their receiver
+MUTATOR_METHODS = frozenset({
+    "append", "add", "insert", "extend", "update", "setdefault",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+})
+
+
+def _mutable_global_names(mi: ModuleIndex) -> Dict[str, int]:
+    """Module-level names bound to mutable containers -> lineno."""
+    out: Dict[str, int] = {}
+    for name, value in mi.module_assigns.items():
+        if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+            out[name] = value.lineno
+        elif isinstance(value, ast.Call) and \
+                terminal_name(value.func) in MUTABLE_CALLS:
+            out[name] = value.lineno
+    return out
+
+
+def _mutations(fi: FuncInfo, globals_: Set[str]
+               ) -> List[Tuple[ast.AST, str, str]]:
+    """(node, global-name, how) for each own-body mutation of a
+    module-level container."""
+    out = []
+    declared = {n for node in own_body_nodes(fi.node)
+                if isinstance(node, ast.Global) for n in node.names}
+    for n in own_body_nodes(fi.node):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr in MUTATOR_METHODS and \
+                isinstance(n.func.value, ast.Name) and \
+                n.func.value.id in globals_:
+            out.append((n, n.func.value.id, n.func.attr + "()"))
+        elif isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in globals_:
+                    out.append((n, t.value.id, "subscript-assign"))
+                elif isinstance(t, ast.Name) and t.id in declared and \
+                        t.id in globals_:
+                    out.append((n, t.id, "rebind"))
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in globals_:
+                    out.append((n, t.value.id, "del"))
+    return out
+
+
+class _ConcurrencyScope:
+    """Shared scaffolding: scoped modules, per-function lock info, and
+    thread-spawn reachability over the name-based call graph."""
+
+    def __init__(self, ctx: AnalysisContext):
+        self.ctx = ctx
+        rels = common.scoped(ctx, prefixes=SCOPE_PREFIXES,
+                             files=SCOPE_FILES)
+        self.modules = ctx.resolver.modules(rels)
+        self.functions: List[FuncInfo] = []
+        for mi in self.modules:
+            self.functions.extend(mi.functions)
+
+    def callees(self, fi: FuncInfo, node: Optional[ast.AST] = None
+                ) -> List[FuncInfo]:
+        calls = (fi.own_calls if node is None else
+                 [n for n in ast.walk(node) if isinstance(n, ast.Call)])
+        out: List[FuncInfo] = []
+        for c in calls:
+            out.extend(self.ctx.resolver.resolve_call(
+                fi, c, self.modules))
+        return out
+
+    def thread_reachable(self) -> Set[str]:
+        """qualnames of scope functions reachable from any thread/pool
+        spawn site anywhere in the package."""
+        roots: Set[str] = set()
+        for rel in self.ctx.project.files():
+            mi = self.ctx.resolver.module(rel)
+            if mi is None:
+                continue
+            for call in common.iter_spawn_sites(mi.tree):
+                roots |= common.spawn_target_names(call)
+        by_name: Dict[str, List[FuncInfo]] = {}
+        for fi in self.functions:
+            by_name.setdefault(fi.name, []).append(fi)
+        seen: Set[str] = set()
+        work = [fi for name in roots for fi in by_name.get(name, ())]
+        while work:
+            fi = work.pop()
+            key = common.func_loc(fi)
+            if key in seen:
+                continue
+            seen.add(key)
+            work.extend(self.callees(fi))
+        return seen
+
+
+class LockOrderRule(Rule):
+    id = "lock-order"
+    title = "no lock-acquisition-order cycles across subsystems"
+
+    MAX_DEPTH = 4
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        scope = _ConcurrencyScope(ctx)
+        #: lock -> {held-then-acquired lock -> example site}
+        edges: Dict[str, Dict[str, str]] = {}
+        all_locks: Set[str] = set()
+
+        def acquired_by(fi: FuncInfo, depth: int,
+                        visited: Set[str]) -> Set[str]:
+            """Locks acquired by fi or its (scope-resolved) callees."""
+            key = common.func_loc(fi)
+            if key in visited or depth > self.MAX_DEPTH:
+                return set()
+            visited.add(key)
+            got: Set[str] = set()
+            for _w, expr in common.iter_with_locks(fi.node):
+                got.add(common.lock_identity(
+                    fi.module, fi.class_name, expr))
+            for callee in scope.callees(fi):
+                got |= acquired_by(callee, depth + 1, visited)
+            return got
+
+        for fi in scope.functions:
+            for w, expr in common.iter_with_locks(fi.node):
+                held = common.lock_identity(fi.module, fi.class_name,
+                                            expr)
+                all_locks.add(held)
+                inner: Set[str] = set()
+                for stmt in w.body:
+                    for n in ast.walk(stmt):
+                        if isinstance(n, ast.With):
+                            for item in n.items:
+                                if common.is_lock_expr(
+                                        item.context_expr):
+                                    inner.add(common.lock_identity(
+                                        fi.module, fi.class_name,
+                                        item.context_expr))
+                for callee in set(scope.callees(fi, node=w)):
+                    inner |= acquired_by(callee, 1,
+                                         {common.func_loc(fi)})
+                for lk in inner:
+                    if lk != held:
+                        edges.setdefault(held, {}).setdefault(
+                            lk, f"{fi.module}:{fi.qualname} "
+                                f"(line {w.lineno})")
+
+        # cycle detection over the lock graph (iterative DFS)
+        for cyc in _cycles(edges):
+            path = " -> ".join(cyc + [cyc[0]])
+            sites = "; ".join(
+                edges[a].get(b, "?") for a, b in
+                zip(cyc, cyc[1:] + [cyc[0]]))
+            out.append(self.finding(
+                "cycle", common.PKG + "scheduler", 0,
+                f"lock-order cycle: {path} (witness sites: {sites})",
+                detail=path))
+        out.extend(self.health(
+            len(all_locks) >= 3, common.PKG + "scheduler",
+            f"expected >=3 distinct locks in the concurrency scope, "
+            f"saw {len(all_locks)}: {sorted(all_locks)}"))
+        return out
+
+
+def _cycles(edges: Dict[str, Dict[str, str]]) -> List[List[str]]:
+    """Elementary cycles via DFS on the lock graph; each cycle is
+    reported once, rotated to start at its smallest node."""
+    found: Dict[str, List[str]] = {}
+
+    def dfs(start: str, node: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start:
+                cyc = path[:]
+                i = cyc.index(min(cyc))
+                rot = cyc[i:] + cyc[:i]
+                found.setdefault("|".join(rot), rot)
+            elif nxt not in on_path and nxt > start:
+                on_path.add(nxt)
+                dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(edges):
+        dfs(start, start, [start], {start})
+    return [found[k] for k in sorted(found)]
+
+
+class RaceGlobalRule(Rule):
+    id = "race-global"
+    title = "module-level mutable state mutated off-thread needs a lock"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        scope = _ConcurrencyScope(ctx)
+        reachable = scope.thread_reachable()
+        globals_checked = 0
+        for mi in scope.modules:
+            mutable = _mutable_global_names(mi)
+            if not mutable:
+                continue
+            globals_checked += len(mutable)
+            names = set(mutable)
+            for fi in mi.functions:
+                muts = _mutations(fi, names)
+                if not muts:
+                    continue
+                if fi.name.endswith("_locked"):
+                    # *_locked convention: caller holds the owning lock
+                    continue
+                guarded = common.guarded_node_ids(fi.node)
+                qual = common.func_loc(fi)
+                for node, gname, how in muts:
+                    if id(node) in guarded:
+                        continue
+                    if qual not in reachable and \
+                            not self._is_thread_entry(fi):
+                        # only mutations on thread-reachable paths race
+                        continue
+                    out.append(self.finding(
+                        "unlocked-mutation", fi.module, node.lineno,
+                        f"{fi.qualname}() mutates module global "
+                        f"{gname!r} ({how}) on a thread-reachable "
+                        f"path with no lock held",
+                        detail=f"{fi.qualname}:{gname}:{how}"))
+        out.extend(self.health(
+            globals_checked >= 1, common.PKG + "recovery/store.py",
+            f"expected >=1 module-level mutable global in the "
+            f"concurrency scope, saw {globals_checked}"))
+        return out
+
+    @staticmethod
+    def _is_thread_entry(fi: FuncInfo) -> bool:
+        # daemon loop convention: _*_loop / run() methods are thread
+        # bodies even when the spawn site is outside the scope modules
+        return fi.name.endswith("_loop") or fi.name == "run"

@@ -1,0 +1,281 @@
+"""resource-pair — every tracked acquire is released on unwind.
+
+For each tracked (acquire, release) pair, a function that acquires
+must make the release unwind-reachable:
+
+* the release call sits in a ``finally`` block or an exception
+  handler of the same function, or
+* the acquire happens inside a ``with`` (context-managed), or
+* the release is the statement *immediately following* the acquire's
+  statement in the same block (zero-width failure window — the
+  load-then-drop hand-off the sort/join spill readers use), or
+* the function only *returns* the acquired resource (an acquire
+  wrapper): then its callers are checked instead, and a class that
+  pairs an acquire wrapper with a release method is a custodian
+  (``BroadcastHandle``-style — consumers own the pairing), or
+* the function is an audited cross-function custodian (allowlisted
+  below with a justification).
+
+The semaphore's task-scoped pair (``acquire_if_necessary`` /
+``release_task``) is intentionally NOT per-function: permits belong to
+the *task*, released by the drain harness — so for it the rule checks
+the custodians instead (kind=task-scope): the plan-level drain and the
+scheduler worker must release in a ``finally``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..engine import AnalysisContext, Rule
+from ..findings import Finding
+from ..resolver import FuncInfo, terminal_name
+from . import common
+
+#: (acquire terminal name, release terminal name)
+PAIRS = (
+    ("acquire_batch", "release_batch"),
+    ("try_reserve", "release_reservation"),
+    ("pin", "unpin"),
+)
+
+#: audited cross-function custodians: "<module-suffix>:<qualname>" ->
+#: justification (also rendered in docs/static_analysis.md)
+CUSTODIANS: Dict[str, str] = {
+    "scheduler/query_scheduler.py:QueryScheduler._dispatch_loop":
+        "reservation is handed to the worker thread; "
+        "_worker_main's finally releases it (checked by task-scope)",
+    "streaming/stream.py:StreamHandle.start":
+        "checkpoint pin spans the stream handle's lifetime; "
+        "stop() unpins (exercised by test_streaming lifecycle tests)",
+    "streaming/stream.py:StreamHandle.__init__":
+        "checkpoint pin spans the stream handle's lifetime; "
+        "stop() unpins (exercised by test_streaming lifecycle tests)",
+}
+
+#: functions that ARE the pair implementation (the registry methods
+#: themselves): pairing is checked at their call sites, not inside
+IMPLEMENTATION_NAMES = frozenset(
+    n for pair in PAIRS for n in pair)
+
+
+def _blocks_of(stmt: ast.stmt):
+    for field in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, field, None)
+        if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+            yield b
+    for h in getattr(stmt, "handlers", None) or ():
+        yield h.body
+
+
+def _enclosing_stmt_map(fn: ast.AST) -> Dict[int, ast.stmt]:
+    """id(node) -> the innermost block-level statement containing it
+    (outer blocks visited first, inner visits overwrite)."""
+    out: Dict[int, ast.stmt] = {}
+
+    def visit(block) -> None:
+        for stmt in block:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(stmt):
+                out[id(sub)] = stmt
+        for stmt in block:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue
+            for b in _blocks_of(stmt):
+                visit(b)
+
+    visit(fn.body)
+    return out
+
+
+def _with_node_ids(fn: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.With):
+            for item in n.items:
+                for sub in ast.walk(item.context_expr):
+                    out.add(id(sub))
+    return out
+
+
+class ResourcePairRule(Rule):
+    id = "resource-pair"
+    title = "tracked acquires release on unwind (finally/with/custodian)"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = [r for r in ctx.project.files()
+                if not r.startswith(common.PKG + "analysis/")]
+        functions = ctx.resolver.functions(rels)
+        checked = 0
+        custodians_hit: Set[str] = set()
+
+        #: acquire-wrapper aliases discovered in pass 1:
+        #: wrapper-name -> release name its callers must pair
+        aliases: Dict[str, str] = {}
+        deferred: List[Tuple[FuncInfo, str, str, int]] = []
+
+        for acquire, release in PAIRS:
+            for fi in functions:
+                if fi.name in IMPLEMENTATION_NAMES:
+                    continue
+                sites = [c for c in fi.own_calls
+                         if terminal_name(c.func) == acquire]
+                if not sites:
+                    continue
+                checked += 1
+                verdict = self._check(fi, sites, release,
+                                      custodians_hit)
+                if verdict == "wrapper":
+                    if self._class_pairs_release(ctx, fi, release):
+                        # BroadcastHandle-style custodian class: the
+                        # acquire wrapper's sibling method releases;
+                        # consumers own the pairing via `with`/finally
+                        continue
+                    if "_" in fi.name:
+                        aliases[fi.name] = release
+                    else:
+                        # generic-named bare wrapper with no releasing
+                        # sibling: can't be tracked — report it
+                        deferred.append((fi, acquire, release,
+                                         sites[0].lineno))
+                elif verdict is not None:
+                    deferred.append((fi, acquire, release, verdict))
+
+        # pass 2: wrapper aliases (e.g. acquire_block -> release_batch)
+        for alias, release in aliases.items():
+            if alias in IMPLEMENTATION_NAMES:
+                continue
+            for fi in functions:
+                if fi.name == alias:
+                    continue
+                sites = [c for c in fi.own_calls
+                         if terminal_name(c.func) == alias]
+                if not sites:
+                    continue
+                checked += 1
+                verdict = self._check(fi, sites, release,
+                                      custodians_hit)
+                if verdict not in (None, "wrapper"):
+                    deferred.append((fi, alias, release, verdict))
+
+        for fi, acquire, release, lineno in deferred:
+            out.append(self.finding(
+                "leak", fi.module, lineno,
+                f"{fi.qualname}() calls {acquire}() but {release}() "
+                f"is not unwind-reachable (no finally/except/with, "
+                f"no adjacent release, not an audited custodian)",
+                detail=f"{fi.qualname}:{acquire}"))
+
+        out.extend(self._task_scope(ctx))
+        out.extend(self.health(
+            checked >= 8, common.PKG + "memory/spill.py",
+            f"expected >=8 acquiring functions, saw {checked}"))
+        out.extend(self.health(
+            len(custodians_hit) >= 2, common.PKG + "scheduler",
+            f"expected >=2 audited custodians to match, matched "
+            f"{sorted(custodians_hit)}"))
+        return out
+
+    def _check(self, fi: FuncInfo, sites: List[ast.Call],
+               release: str, custodians_hit: Set[str]):
+        """None = ok; "wrapper" = acquire-only wrapper; else the line
+        number of the unpaired acquire."""
+        for key, _just in CUSTODIANS.items():
+            mod_suffix, qual = key.split(":", 1)
+            if fi.module.endswith(mod_suffix) and fi.qualname == qual:
+                custodians_hit.add(key)
+                return None
+
+        fin_ids = common.finally_node_ids(fi.node)
+        releases = [c for c in fi.own_calls
+                    if terminal_name(c.func) == release]
+        if any(id(c) in fin_ids for c in releases):
+            return None
+
+        with_ids = _with_node_ids(fi.node)
+        stmt_of = _enclosing_stmt_map(fi.node)
+        returned = {id(sub) for n in ast.walk(fi.node)
+                    if isinstance(n, ast.Return) and n.value is not None
+                    for sub in ast.walk(n.value)}
+        release_stmts = {id(stmt_of.get(id(c))) for c in releases}
+
+        all_wrapped = True
+        for call in sites:
+            if id(call) in with_ids:
+                # `with handle.acquire()...` — context-managed
+                continue
+            if id(call) in returned:
+                continue  # wrapper-shaped at this site
+            all_wrapped = False
+            stmt = stmt_of.get(id(call))
+            nxt = self._next_stmt(fi.node, stmt)
+            if nxt is not None and id(nxt) in release_stmts:
+                continue  # adjacent-statement hand-off
+            return call.lineno
+        if all_wrapped and any(id(c) in returned for c in sites):
+            return "wrapper"
+        return None
+
+    @staticmethod
+    def _class_pairs_release(ctx: AnalysisContext, fi: FuncInfo,
+                             release: str) -> bool:
+        if fi.class_name is None:
+            return False
+        mi = ctx.resolver.module(fi.module)
+        if mi is None:
+            return False
+        return any(other.class_name == fi.class_name and
+                   release in other.own_call_names
+                   for other in mi.functions)
+
+    @staticmethod
+    def _next_stmt(fn: ast.AST, stmt: Optional[ast.stmt]
+                   ) -> Optional[ast.stmt]:
+        if stmt is None:
+            return None
+        for block in common.statement_sequences(fn):
+            for i, s in enumerate(block):
+                if s is stmt:
+                    return block[i + 1] if i + 1 < len(block) else None
+        return None
+
+    def _task_scope(self, ctx: AnalysisContext) -> List[Finding]:
+        """The semaphore's task-scoped custodians: the scheduler worker
+        and the plan-level drain must release permits/reservations in a
+        ``finally``."""
+        out: List[Finding] = []
+        requirements = (
+            ("scheduler/query_scheduler.py", "_worker_main",
+             ("release_task", "release_reservation")),
+            ("plan/physical.py", None, ("release_task",)),
+        )
+        for mod_suffix, fname, needs in requirements:
+            rel = common.PKG + mod_suffix
+            mi = ctx.resolver.module(rel)
+            if mi is None:
+                out.append(self.finding(
+                    "task-scope", rel, 0,
+                    f"expected custodian module {mod_suffix} missing"))
+                continue
+            cands = (mi.by_name.get(fname, []) if fname
+                     else mi.functions)
+            ok = set()
+            for fi in cands:
+                fin = common.finally_node_ids(fi.node)
+                for c in fi.own_calls:
+                    if terminal_name(c.func) in needs and \
+                            id(c) in fin:
+                        ok.add(terminal_name(c.func))
+            missing = [n for n in needs if n not in ok]
+            if missing:
+                out.append(self.finding(
+                    "task-scope", rel, 0,
+                    f"{mod_suffix}{':' + fname if fname else ''} must "
+                    f"release {missing} inside a finally (task-scoped "
+                    f"device permits must drop on unwind)",
+                    detail=f"{mod_suffix}:{fname}:{','.join(missing)}"))
+        return out

@@ -1,0 +1,183 @@
+"""Telemetry discipline rules: bare-emit, emit-safe, thread-capture,
+worker-unbind, overloaded-hint.
+
+The engine's telemetry contract: exactly one exception-safe emission
+funnel (``telemetry.events.emit_event``), every thread/pool hop
+re-binds the ambient span context (``spans.capture``/``bound``/
+``attached``), the scheduler worker unwinds its ambient bindings in
+``finally``, and admission rejections always carry a retry hint.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..engine import AnalysisContext, Rule
+from ..findings import Finding
+from ..resolver import terminal_name
+from . import common
+
+
+class BareEmitRule(Rule):
+    id = "bare-emit"
+    title = "only telemetry/ calls .emit() directly"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = [r for r in ctx.project.files()
+                if not r.startswith(common.PKG + "telemetry/")
+                and not r.startswith(common.PKG + "analysis/")]
+        for fi in ctx.resolver.functions(rels):
+            for call in fi.own_calls:
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr == "emit":
+                    out.append(self.finding(
+                        "bare-emit", fi.module, call.lineno,
+                        f"{fi.qualname}() calls .emit() directly — "
+                        f"use telemetry.events.emit_event (the "
+                        f"exception-safe funnel)",
+                        detail=f"{fi.qualname}:emit"))
+        return out
+
+
+class EmitSafeRule(Rule):
+    id = "emit-safe"
+    title = "emit_event never lets a telemetry error fail a query"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rel = common.PKG + "telemetry/events.py"
+        mi = ctx.resolver.module(rel)
+        if mi is None:
+            return [self.finding("health", rel, 0,
+                                 "telemetry/events.py missing")]
+        fns = mi.by_name.get("emit_event", [])
+        out.extend(self.health(
+            len(fns) >= 1, rel, "emit_event not found"))
+        for fi in fns:
+            # body minus the docstring must be a try whose handlers
+            # swallow Exception (the whole funnel is shielded)
+            body = [s for s in fi.node.body
+                    if not (isinstance(s, ast.Expr) and
+                            isinstance(s.value, ast.Constant))]
+            safe = bool(body) and all(
+                isinstance(s, ast.Try) and any(
+                    h.type is None or
+                    common.has_name(h.type, "Exception") or
+                    common.has_name(h.type, "BaseException")
+                    for h in s.handlers)
+                for s in body)
+            if not safe:
+                out.append(self.finding(
+                    "unsafe-funnel", rel, fi.lineno,
+                    "emit_event's body must be wrapped in "
+                    "try/except Exception — a telemetry bug must "
+                    "never fail the query it observes",
+                    detail="emit_event:try-except"))
+        return out
+
+
+class ThreadCaptureRule(Rule):
+    id = "thread-capture"
+    title = "thread/pool spawns re-bind telemetry span context"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = [r for r in ctx.project.files()
+                if r.startswith(common.PKG)
+                and not r.startswith(common.PKG + "telemetry/")
+                and not r.startswith(common.PKG + "analysis/")]
+        spawns = 0
+        for fi in ctx.resolver.functions(rels):
+            fn_has_capture = bool(
+                common.call_names(fi.node) & common.CAPTURE_NAMES)
+            for call in fi.own_calls:
+                name = terminal_name(call.func)
+                if name not in common.SPAWN_NAMES:
+                    continue
+                spawns += 1
+                if name in ("Thread", "Timer"):
+                    # per-site: the target expression itself must be
+                    # wrapped (bound(capture(), fn) / attached(fn))
+                    ok = bool(common.spawn_target_names(call) &
+                              common.CAPTURE_NAMES)
+                else:
+                    # pools submit later; the enclosing function must
+                    # bind via capture()/bound()/attached() somewhere
+                    ok = fn_has_capture
+                if not ok:
+                    out.append(self.finding(
+                        "unbound-spawn", fi.module, call.lineno,
+                        f"{fi.qualname}() spawns {name} without "
+                        f"capturing span context "
+                        f"({sorted(common.CAPTURE_NAMES)}) — events "
+                        f"from that thread lose their query binding",
+                        detail=f"{fi.qualname}:{name}"))
+        out.extend(self.health(
+            spawns >= 5, common.PKG + "scheduler",
+            f"expected >=5 spawn sites package-wide, saw {spawns}"))
+        return out
+
+
+class WorkerUnbindRule(Rule):
+    id = "worker-unbind"
+    title = "scheduler worker unwinds ambient bindings in finally"
+
+    NEEDS = ("deactivate", "bind_scoped_injector",
+             "bind_scoped_fault_injector")
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rel = common.PKG + "scheduler/query_scheduler.py"
+        mi = ctx.resolver.module(rel)
+        if mi is None:
+            return [self.finding("health", rel, 0,
+                                 "query_scheduler.py missing")]
+        workers = mi.by_name.get("_worker_main", [])
+        out.extend(self.health(
+            len(workers) >= 1, rel, "_worker_main not found"))
+        for fi in workers:
+            if "activate" not in fi.own_call_names:
+                out.append(self.finding(
+                    "worker-bind", rel, fi.lineno,
+                    "_worker_main must activate() the task's "
+                    "telemetry token",
+                    detail="_worker_main:activate"))
+            fin = common.finally_node_ids(fi.node)
+            in_finally = {terminal_name(c.func)
+                          for c in fi.own_calls if id(c) in fin}
+            missing = [n for n in self.NEEDS if n not in in_finally]
+            if missing:
+                out.append(self.finding(
+                    "worker-unbind", rel, fi.lineno,
+                    f"_worker_main's finally must unwind ambient "
+                    f"bindings: missing {missing} — a crashed task "
+                    f"would leak its injector/span into the next "
+                    f"task on this worker",
+                    detail=f"_worker_main:{','.join(missing)}"))
+        return out
+
+
+class OverloadedHintRule(Rule):
+    id = "overloaded-hint"
+    title = "TpuOverloaded always carries retry_after_ms"
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        sites = 0
+        for fi in ctx.resolver.functions(ctx.project.files()):
+            for call in fi.own_calls:
+                if terminal_name(call.func) == "TpuOverloaded":
+                    sites += 1
+                    if not any(k.arg == "retry_after_ms"
+                               for k in call.keywords):
+                        out.append(self.finding(
+                            "missing-hint", fi.module, call.lineno,
+                            f"{fi.qualname}() raises TpuOverloaded "
+                            f"without retry_after_ms= — clients "
+                            f"need the backpressure hint",
+                            detail=f"{fi.qualname}:TpuOverloaded"))
+        out.extend(self.health(
+            sites >= 1, common.PKG + "scheduler/qos.py",
+            f"expected >=1 TpuOverloaded construction, saw {sites}"))
+        return out

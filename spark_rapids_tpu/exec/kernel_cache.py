@@ -1,8 +1,8 @@
 """Process-wide kernel-compilation cache.
 
 Every device exec routes its jit compilation through here instead of
-calling ``jax.jit`` directly (enforced by the AST lint in
-tests/test_lint_kernel_cache.py), which buys three things the scattered
+calling ``jax.jit`` directly (enforced by the ``jit-direct`` analysis
+rule), which buys three things the scattered
 per-exec ``_jit`` helpers could not:
 
 * **Sharing** — entries are keyed by a kernel *fingerprint* (operator
@@ -92,7 +92,8 @@ class _CachedKernel:
 
     def __call__(self, *args, metrics=None):
         # the disabled-profiler cost is this ONE attribute read — no
-        # allocation, no lock (tests/test_lint_profiler.py pins both)
+        # allocation, no lock (the profiler-guard analysis rule pins
+        # both)
         prof = PROFILER if PROFILER.enabled else None
         before = self._shape_cache_size()
         t0 = time.perf_counter_ns()

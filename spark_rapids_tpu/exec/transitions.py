@@ -317,8 +317,8 @@ class HostToDeviceExec(TpuExec):
 
                 # the producer thread inherits no thread-locals: the
                 # telemetry binding is captured here and attached in
-                # the worker (test_lint_telemetry.py enforces this at
-                # every spawn site)
+                # the worker (the thread-capture analysis rule
+                # enforces this at every spawn site)
                 t = threading.Thread(
                     target=tspans.bound(tspans.capture(), produce),
                     daemon=True, name=f"h2d-prefetch-{pid}")

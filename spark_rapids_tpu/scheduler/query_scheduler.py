@@ -440,8 +440,8 @@ class QueryScheduler:
         event is emitted there, on the victim's own worker thread,
         where its telemetry binding (and event ring) lives — the
         dispatcher thread has no query binding
-        (tests/test_lint_qos.py allowlists this site for that
-        reason)."""
+        (the decision-event analysis rule allowlists this site for
+        that reason)."""
         if not self.preemption_enabled:
             return
         if self._preempt_inflight is not None:

@@ -155,9 +155,9 @@ def fetch_counts(handles):
     """The ONE gated host readback of the device exchange write path:
     a single batched ``jax.device_get`` of the flush chunk's
     counts/starts vectors (tiny int32[n_out] pairs — per-block syncs
-    would be a device RTT each).  Named so the shuffle AST lint
-    (tests/test_lint_shuffle.py) can allowlist exactly this function
-    as the device path's host materialization point."""
+    would be a device RTT each).  Named so the host-sync analysis
+    rule can gate exactly this function as the device path's host
+    materialization point."""
     import jax
 
     return jax.device_get(list(handles))

@@ -72,8 +72,11 @@ noteworthy engine transition emits one flat JSON record:
 
 Emission contract: call sites OUTSIDE ``telemetry/`` must only use
 :func:`emit_event`, which is exception-safe (never raises, never
-blocks recovery) and a no-op when no query telemetry is active —
-``tests/test_lint_telemetry.py`` enforces this at the AST level.
+blocks recovery) and a no-op when no query telemetry is active — the
+analysis engine (``python -m spark_rapids_tpu.analysis``, rules
+``bare-emit``/``emit-safe``) enforces this at the AST level, and its
+``event-drift`` rule keeps :data:`EVENT_CATALOG` in lockstep with the
+emitting call sites.
 
 Multi-controller runs ship events back alongside the result gather:
 :func:`gather_multiprocess_events` allgathers every controller's local
@@ -90,6 +93,36 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from . import spans
+
+#: Every event name the engine may emit — the drift source of truth.
+#: The analysis engine's ``event-drift`` rule checks this both ways:
+#: an emitted literal missing here fails the build, and so does a
+#: catalog entry nothing emits.  Names are documented in the module
+#: docstring above and in docs/observability.md.
+EVENT_CATALOG = frozenset({
+    # query lifecycle (emitted via the spans funnel)
+    "query_begin", "query_end", "query_cancelled",
+    # memory / OOM recovery
+    "spill", "retry", "split", "admission_reject",
+    # fault tolerance
+    "checksum_failure", "watchdog_trip", "stage_retry", "degrade",
+    "fault_injected", "shuffle_fallback", "attempt_budget_exhausted",
+    # adaptive execution
+    "aqe_stage_stats", "aqe_broadcast_join", "aqe_skew_split",
+    "aqe_coalesce_partitions", "aqe_reservation_rebase",
+    "aqe_final_plan",
+    # durable checkpoints
+    "checkpoint_write", "checkpoint_resume", "checkpoint_quarantine",
+    "checkpoint_disabled",
+    # QoS / overload
+    "overload_enter", "overload_exit", "overload_shed",
+    "preempt_victim", "preempt_resume",
+    # streaming
+    "stream_start", "stream_stop", "stream_tick_skip",
+    "stream_batch_start", "stream_batch_commit", "stream_batch_capped",
+    "stream_batch_error", "stream_incremental_merge",
+    "stream_incremental_skip",
+})
 
 
 class EventLog:

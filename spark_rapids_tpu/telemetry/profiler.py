@@ -9,7 +9,8 @@ h2d ceiling (peak bytes/s) anchors the roofline: a kernel far below the
 ceiling on bytes/s is compute-bound, not transfer-bound — which is the
 question ROADMAP item 2 needs answered per kernel, not per query.
 
-Hot-path discipline (enforced by tests/test_lint_profiler.py):
+Hot-path discipline (enforced by the ``profiler-guard`` and
+``host-sync`` analysis rules):
 
 * the disabled cost is ONE attribute read (``PROFILER.enabled``) per
   dispatch — no allocation, no locking;

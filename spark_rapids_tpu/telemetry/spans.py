@@ -13,9 +13,9 @@ never inherit thread-locals, so every thread-spawn site must
 under :func:`attached` (or wrap the target with :func:`bound`) — the
 same discipline a query-governor ``activate(current_query())`` binding
 uses, and composable with one when a ``governor`` package is present
-(capture both, attach both).  ``tests/test_lint_telemetry.py`` enforces
-the capture at the AST level for every thread-spawn site in the
-package.
+(capture both, attach both).  The ``thread-capture`` analysis rule
+enforces the capture at the AST level for every thread-spawn site in
+the package.
 
 Cost model: with ``telemetry.enabled=false`` nothing here is reachable
 beyond a thread-local ``getattr`` returning ``None`` — no spans, no
