@@ -650,6 +650,40 @@ def _wall_per_dispatch(row):
     return None
 
 
+#: elastic peer-loss drill fields emitted by the multichip dryrun
+#: (__graft_entry__._dryrun_impl prints the MULTICHIP_ELASTIC marker
+#: into the artifact's captured tail)
+ELASTIC_FIELDS = ("degraded_devices", "respeculated_shards",
+                  "mesh_shrink_count")
+
+
+def _elastic_summary(art):
+    """The elastic drill counters of a MULTICHIP artifact, or None.
+
+    Accepts either top-level fields or the ``MULTICHIP_ELASTIC {json}``
+    marker line inside the artifact's captured ``tail`` (the external
+    driver stores the dryrun's stdout there); the LAST marker wins."""
+    if not isinstance(art, dict):
+        return None
+    if all(k in art for k in ELASTIC_FIELDS):
+        return {k: art[k] for k in ELASTIC_FIELDS}
+    tail = art.get("tail")
+    if not isinstance(tail, str):
+        return None
+    out = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith("MULTICHIP_ELASTIC "):
+            continue
+        try:
+            rec = json.loads(line[len("MULTICHIP_ELASTIC "):])
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out = {k: rec.get(k, 0) for k in ELASTIC_FIELDS}
+    return out
+
+
 def compare_summaries(old, new, threshold=0.20):
     """Regression gate core: diff two bench summary artifacts.
 
@@ -660,6 +694,14 @@ def compare_summaries(old, new, threshold=0.20):
     when the artifacts carry different ``schema_version``s: diffing
     renamed/re-scoped fields would report garbage, so the gate refuses
     and tells the caller to re-baseline instead.
+
+    MULTICHIP artifacts additionally diff the elastic peer-loss drill
+    (``_elastic_summary``): the drill DELIBERATELY kills a peer and
+    stalls a shard, so the baseline's counters are the expected
+    behaviour — detection regressing to zero (no mesh shrink where the
+    baseline shrank, no speculative win where the baseline
+    respeculated) or MORE devices degraded than the baseline are
+    regressions.
     """
     ov, nv = old.get("schema_version"), new.get("schema_version")
     if ov != nv:
@@ -700,6 +742,19 @@ def compare_summaries(old, new, threshold=0.20):
                              "old": round(bwpd, 6),
                              "new": round(nwpd, 6),
                              "ratio": round(nwpd / bwpd, 2)})
+    o_el, n_el = _elastic_summary(old), _elastic_summary(new)
+    if o_el is not None and n_el is not None:
+        for field, bad_when in (("mesh_shrink_count", "lost"),
+                                ("respeculated_shards", "lost"),
+                                ("degraded_devices", "grew")):
+            b, v = o_el.get(field), n_el.get(field)
+            if not isinstance(b, (int, float)) \
+                    or not isinstance(v, (int, float)):
+                continue
+            if (bad_when == "lost" and b > 0 and v <= 0) or \
+                    (bad_when == "grew" and v > b):
+                regs.append({"query": "elastic_drill", "field": field,
+                             "old": b, "new": v})
     return regs
 
 

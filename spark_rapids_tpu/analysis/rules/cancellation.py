@@ -94,43 +94,72 @@ class CancelPollRule(Rule):
         return out
 
 
+#: the ONE module allowed to dispatch cross-controller collectives
+#: directly — everything else must route through its guarded funnels
+ELASTIC_MODULE = common.PKG + "parallel/elastic.py"
+
+
 class CollectiveCancelRule(Rule):
     id = "collective-cancel"
-    title = "collectives poll cancellation before blocking the mesh"
+    title = "collectives route through the guarded elastic funnel"
 
     def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
         out: List[Finding] = []
-        rels = common.scoped(ctx, prefixes=("parallel/",))
-        # the exchange step itself
-        steps = [fi for fi in ctx.resolver.functions(rels)
-                 if fi.name == "exchange_step"]
-        for fi in steps:
-            # the poll lives in the returned dispatch closure — check
-            # the whole subtree, nested defs included
+        # whole-program: a direct process_allgather anywhere outside
+        # parallel/elastic.py bypasses the cancellation poll, the
+        # collective wall-clock accounting AND the peer-loss deadline —
+        # a dead peer would wedge that call site forever
+        dispatchers = 0
+        for fi in ctx.resolver.functions(ctx.project.files()):
+            if "process_allgather" not in fi.own_call_names:
+                continue
+            if fi.module != ELASTIC_MODULE:
+                out.append(self.finding(
+                    "allgather", fi.module, fi.lineno,
+                    f"{fi.qualname}() dispatches process_allgather "
+                    f"directly — route it through "
+                    f"elastic.guarded_allgather (cancellation poll + "
+                    f"fault.peer.collectiveTimeoutMs guard)",
+                    detail=f"{fi.qualname}:allgather"))
+            else:
+                dispatchers += 1
+        out.extend(self.health(
+            dispatchers == 1, ELASTIC_MODULE,
+            f"expected exactly one process_allgather dispatcher in "
+            f"the elastic funnel, saw {dispatchers}"))
+        # the funnel itself must poll: guarded_call is the one place
+        # cancellation is checked before joining a mesh-wide
+        # collective, so every routed site inherits it
+        guards = [fi for fi in ctx.resolver.functions([ELASTIC_MODULE])
+                  if fi.name == "guarded_call"]
+        for fi in guards:
             if not any(terminal_name(c.func) == "check_cancel"
                        for c in fi.all_calls()):
                 out.append(self.finding(
+                    "guard-poll", fi.module, fi.lineno,
+                    "guarded_call() never polls check_cancel — one "
+                    "cancelled participant would wedge every peer",
+                    detail="guarded_call:check_cancel"))
+        out.extend(self.health(
+            len(guards) == 1, ELASTIC_MODULE,
+            f"expected exactly one guarded_call funnel, "
+            f"saw {len(guards)}"))
+        # the exchange step dispatches THROUGH the funnel
+        rels = common.scoped(ctx, prefixes=("parallel/",))
+        steps = [fi for fi in ctx.resolver.functions(rels)
+                 if fi.name == "exchange_step"]
+        for fi in steps:
+            # the routing lives in the returned dispatch closure —
+            # check the whole subtree, nested defs included
+            if not any(terminal_name(c.func) == "guarded_call"
+                       for c in fi.all_calls()):
+                out.append(self.finding(
                     "exchange-step", fi.module, fi.lineno,
-                    "exchange_step() must check_cancel before the "
-                    "collective — one cancelled participant would "
-                    "wedge every peer",
-                    detail="exchange_step:check_cancel"))
+                    "exchange_step() must dispatch through "
+                    "elastic.guarded_call — a direct collective has "
+                    "no cancellation poll or peer-loss guard",
+                    detail="exchange_step:guarded_call"))
         out.extend(self.health(
             len(steps) == 1, common.PKG + "parallel/exchange.py",
             f"expected exactly one exchange_step, saw {len(steps)}"))
-        # every allgather dispatcher polls
-        checked = 0
-        for fi in ctx.resolver.functions(rels):
-            if "process_allgather" in fi.own_call_names:
-                checked += 1
-                if "check_cancel" not in fi.own_call_names:
-                    out.append(self.finding(
-                        "allgather", fi.module, fi.lineno,
-                        f"{fi.qualname}() dispatches "
-                        f"process_allgather without check_cancel",
-                        detail=f"{fi.qualname}:allgather"))
-        out.extend(self.health(
-            checked >= 2, common.PKG + "parallel",
-            f"expected >=2 process_allgather dispatchers, "
-            f"saw {checked}"))
         return out

@@ -392,15 +392,31 @@ fault-tolerance layer (`spark_rapids_tpu/fault/`, docs/fault_tolerance.md):
   falls back distributed -> single-process -> CPU-exec plan
   (`fault.degrade.enabled`) instead of failing the query; the final
   rung is reported as `fault.degradeLevel`.
+* **Elastic multi-host execution** — the `fault.peer.*` confs arm peer
+  failure detection (`parallel/elastic.py`): a heartbeat ledger
+  (`fault.peer.heartbeatMs` / `missedHeartbeats` / `heartbeatDir`)
+  detects dead worker processes, and `fault.peer.collectiveTimeoutMs`
+  bounds every guarded collective so a dead peer aborts the dispatch
+  with `TpuPeerLost` instead of wedging the mesh.  The ladder then
+  re-forms the mesh on the surviving devices (the "shrunken mesh" rung
+  above single-process) and re-executes from the recovery substrate's
+  checkpoints rather than from scratch.
+* **Straggler speculation** — `speculation.*` arms duplicate attempts
+  for leaf-drain shards whose latency exceeds
+  `speculation.multiplier` x the rolling `speculation.quantile`
+  percentile; the first result wins and the loser is cancelled through
+  its CancelToken with the zero-leak unwind discipline.
 * **Deterministic injection** — `fault.injection.*` drives every
-  recovery path (`oom|corrupt|delay|stage_crash`, site-filtered,
-  `nth`/`random`/`always` modes) in CI on CPU-only JAX; every injected
-  run must produce results bit-identical to an injection-free run.
+  recovery path (`oom|corrupt|delay|stage_crash|cancel|peer_crash|`
+  `peer_stall`, site-filtered, `nth`/`random`/`always` modes) in CI on
+  CPU-only JAX; every injected run must produce results bit-identical
+  to an injection-free run.
 
 Recovery is observable: `fault.numStageRetries`,
-`fault.numChecksumFailures`, `fault.numWatchdogTrips` and
-`fault.degradeLevel` land in `Session.last_metrics`, and a degraded
-query logs a DEGRADED summary."""
+`fault.numChecksumFailures`, `fault.numWatchdogTrips`,
+`fault.degradeLevel`, `fault.numPeerLost`, `fault.numMeshShrinks` and
+`fault.numSpeculativeWins` land in `Session.last_metrics`, and a
+degraded query logs a DEGRADED summary."""
 
 
 _PERF_TUNING_DOC = """\
@@ -552,7 +568,10 @@ FAULT_INJECTION_TYPE = conf("spark.rapids.tpu.fault.injection.type").doc(
     "verify must catch it), delay (sleep delayMs at the checkpoint — a "
     "straggler), stage_crash (raise TpuStageCrash — a died stage), "
     "cancel (cancel the running query's CancelToken at the checkpoint "
-    "— deterministic mid-stage cancellation for unwind testing)"
+    "— deterministic mid-stage cancellation for unwind testing), "
+    "peer_crash (raise TpuPeerLost — a died peer worker; drives the "
+    "shrunken-mesh rung), peer_stall (sleep delayMs like delay — a "
+    "stalled peer shard; drives straggler speculation)"
 ).string_conf("oom")
 FAULT_INJECTION_SKIP_COUNT = conf(
     "spark.rapids.tpu.fault.injection.skipCount").doc(
@@ -623,6 +642,57 @@ FAULT_MAX_TOTAL_ATTEMPTS = conf(
     "Crossing the ceiling emits one terminal attempt_budget_exhausted "
     "event carrying the full attempt ledger and fails the query with "
     "AttemptBudgetExhausted (0 disables the ceiling)").int_conf(64)
+FAULT_PEER_HEARTBEAT_MS = conf(
+    "spark.rapids.tpu.fault.peer.heartbeatMs").doc(
+    "Interval at which each multi-controller worker process touches "
+    "its heartbeat file in fault.peer.heartbeatDir so peers can detect "
+    "its death without waiting out a wedged collective (0 disables the "
+    "heartbeat ledger)").int_conf(0)
+FAULT_PEER_MISSED_HEARTBEATS = conf(
+    "spark.rapids.tpu.fault.peer.missedHeartbeats").doc(
+    "Consecutive missed heartbeat intervals after which a peer is "
+    "declared lost: a peer whose heartbeat file is staler than "
+    "heartbeatMs * missedHeartbeats aborts in-flight guarded "
+    "collectives with TpuPeerLost and triggers the shrunken-mesh "
+    "rung").int_conf(3)
+FAULT_PEER_COLLECTIVE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.fault.peer.collectiveTimeoutMs").doc(
+    "Deadline on every guarded collective dispatch "
+    "(parallel/elastic.py): a process_allgather / compiled-collective "
+    "call that makes no progress past this deadline is abandoned with "
+    "TpuPeerLost instead of wedging every surviving peer forever (0 "
+    "disables the deadline; dead peers are then only detectable via "
+    "the heartbeat ledger)").int_conf(0)
+FAULT_PEER_HEARTBEAT_DIR = conf(
+    "spark.rapids.tpu.fault.peer.heartbeatDir").doc(
+    "Shared directory for the peer heartbeat ledger (one file per "
+    "process id, mtime = last heartbeat).  Must be visible to every "
+    "worker process — a shared filesystem or a local dir when all "
+    "workers are colocated; empty uses <system tempdir>/"
+    "srt-heartbeats").string_conf("")
+SPECULATION_ENABLED = conf("spark.rapids.tpu.speculation.enabled").doc(
+    "Straggler speculation on leaf drains: when a shard's drain "
+    "latency exceeds speculation.multiplier x the rolling "
+    "speculation.quantile percentile, a duplicate attempt is launched; "
+    "the first result wins and the loser is cancelled through its "
+    "CancelToken with the zero-leak unwind discipline").boolean_conf(False)
+SPECULATION_MULTIPLIER = conf("spark.rapids.tpu.speculation.multiplier").doc(
+    "A shard speculates once its elapsed drain time exceeds this "
+    "multiple of the rolling percentile "
+    "(speculation.quantile)").double_conf(2.0)
+SPECULATION_QUANTILE = conf("spark.rapids.tpu.speculation.quantile").doc(
+    "Percentile of the per-shard drain-latency histogram used as the "
+    "speculation baseline (e.g. 95.0 = p95)").double_conf(95.0)
+SPECULATION_MIN_SAMPLES = conf(
+    "spark.rapids.tpu.speculation.minSamples").doc(
+    "Minimum completed drains in the rolling latency window before "
+    "speculation arms — prevents duplicating shards off a cold, "
+    "unrepresentative baseline").int_conf(4)
+SPECULATION_MIN_LATENCY_MS = conf(
+    "spark.rapids.tpu.speculation.minLatencyMs").doc(
+    "Floor below which a shard never speculates regardless of the "
+    "percentile baseline, so uniformly fast drains do not duplicate "
+    "work over scheduling jitter").double_conf(25.0)
 
 # --- stage-level checkpointing & crash recovery (recovery/;
 # reference: Theseus-style resumable exchange artifacts) -------------------

@@ -53,3 +53,12 @@ class TpuStageTimeout(TpuFaultError):
     a bounded producer/consumer queue made no progress past its
     deadline — the hung unit of work is abandoned and re-executed
     instead of blocking the query forever."""
+
+
+class TpuPeerLost(TpuFaultError):
+    """A peer worker process died or stopped heartbeating mid-query, or
+    a collective exceeded ``fault.peer.collectiveTimeoutMs``.  Unlike
+    the stage-scoped faults above this is NOT stage-retryable (the dead
+    peer would wedge the retry in the same collective): the elastic
+    layer re-forms the mesh on the surviving devices and re-executes
+    from the recovery substrate's checkpoints instead."""

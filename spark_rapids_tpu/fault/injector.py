@@ -24,6 +24,13 @@ Fault types (``spark.rapids.tpu.fault.injection.type``):
   :class:`~..scheduler.cancel.CancelToken` (if bound) and raise
   ``TpuQueryCancelled`` at the checkpoint, so deterministic mid-stage
   cancellation is testable at every site the injector already reaches.
+* ``peer_crash``  — raise :class:`~.errors.TpuPeerLost` at the
+  checkpoint (a died peer worker process); the elastic layer shrinks
+  the mesh and re-executes from checkpoints instead of retrying the
+  stage.
+* ``peer_stall``  — sleep ``delayMs`` at the checkpoint like ``delay``
+  (a stalled peer / straggling shard); with speculation enabled the
+  straggler's shard is duplicated and the duplicate wins.
 
 Modes (``spark.rapids.tpu.fault.injection.mode``) are exactly PR-1's:
 ``none`` (off), ``nth`` (fire once at matching checkpoint #skipCount),
@@ -48,7 +55,8 @@ import threading
 import time
 from typing import Optional
 
-FAULT_TYPES = ("oom", "corrupt", "delay", "stage_crash", "cancel")
+FAULT_TYPES = ("oom", "corrupt", "delay", "stage_crash", "cancel",
+               "peer_crash", "peer_stall")
 
 # ==========================================================================
 # Injection-suppression scopes (moved from memory/retry.py; see module
@@ -226,7 +234,15 @@ class FaultInjector:
             return
         if not self._decide(site):
             return
-        if self.fault_type == "delay":
+        if self.fault_type == "peer_crash":
+            from ..telemetry.events import emit_event
+            from .errors import TpuPeerLost
+
+            emit_event("peer_lost", site=site, injected=True)
+            raise TpuPeerLost(
+                f"injected peer crash (mode={self.mode}, "
+                f"site={site or '?'})", site=site, injected=True)
+        if self.fault_type in ("delay", "peer_stall"):
             # sliced sleep: a straggler whose attempt the stage
             # watchdog has already abandoned must die with it, not
             # linger for the full delay as an orphan thread

@@ -19,6 +19,12 @@ Counters:
   is stable)
 * ``fault.degradeLevel``        — final ladder rung (0 = native plan,
   1 = single-process fallback, 2 = CPU-exec plan)
+* ``fault.numPeerLost``         — peer processes declared dead (missed
+  heartbeats or a collective deadline)
+* ``fault.numMeshShrinks``      — mesh re-formations on the surviving
+  devices after a peer loss
+* ``fault.numSpeculativeWins``  — straggler shards whose speculative
+  duplicate attempt finished first
 """
 from __future__ import annotations
 
@@ -31,7 +37,8 @@ DEGRADE_SINGLE_PROCESS = 1
 DEGRADE_CPU = 2
 
 _COUNTERS = ("numStageRetries", "numChecksumFailures",
-             "numWatchdogTrips", "numShuffleFallbacks", "degradeLevel")
+             "numWatchdogTrips", "numShuffleFallbacks", "degradeLevel",
+             "numPeerLost", "numMeshShrinks", "numSpeculativeWins")
 
 
 class FaultStats:

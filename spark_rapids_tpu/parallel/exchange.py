@@ -175,16 +175,18 @@ def exchange_step(mesh, fn):
     operating on stacked [n_parts, ...] DeviceBatch pytrees.
 
     The returned callable is a Python-level dispatcher (not the raw
-    shard_map program): every collective dispatch polls the query's
-    cancellation token first — a cancelled query must stop at the next
-    exchange instead of joining a mesh-wide collective its peers will
-    wait on — and its wall clock accrues to
-    ``shuffle.collectiveTime``."""
+    shard_map program): every collective dispatch goes through the
+    elastic layer's ``guarded_call`` — the query's cancellation token
+    is polled first (a cancelled query must stop at the next exchange
+    instead of joining a mesh-wide collective its peers will wait on),
+    a dead peer or a tripped ``fault.peer.collectiveTimeoutMs`` aborts
+    with ``TpuPeerLost`` instead of hanging — and its wall clock
+    accrues to ``shuffle.collectiveTime``."""
     from jax.sharding import PartitionSpec as P
 
-    from ..scheduler.cancel import check_cancel
     from ..shuffle.device_shuffle import collective_timer
     from ._compat import get_shard_map
+    from .elastic import guarded_call
 
     shard_map = get_shard_map()
 
@@ -197,9 +199,11 @@ def exchange_step(mesh, fn):
                      out_specs=P(axis))
 
     def dispatch(stacked: DeviceBatch) -> DeviceBatch:
-        check_cancel("shuffle.collective")
-        with collective_timer():
-            return step(stacked)
+        def timed(stacked=stacked):
+            with collective_timer():
+                return step(stacked)
+
+        return guarded_call(timed)
 
     return dispatch
 

@@ -39,6 +39,42 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS):
     return Mesh(np.array(devs), (axis_name,))
 
 
+def surviving_devices(mesh) -> list:
+    """The devices a shrunken mesh re-forms on after a peer loss.
+
+    Multi-controller: the surviving process can only compile against
+    devices it can address, so the shrunken mesh is exactly this
+    process's addressable slice of the old mesh (the dead peer's
+    devices are unreachable by definition).  Single-controller (the CI
+    drill, where every "peer" is a simulated process on one host): the
+    first half of the old mesh stands in for the survivors.
+    """
+    import jax
+
+    devs = list(mesh.devices.flat)
+    try:
+        nprocs = jax.process_count()
+    except Exception:
+        nprocs = 1
+    if nprocs > 1:
+        local = set(d.id for d in jax.local_devices())
+        mine = [d for d in devs if d.id in local]
+        if mine:
+            return mine
+    return devs[:max(1, len(devs) // 2)]
+
+
+def make_shrunken_mesh(mesh, axis_name: str = DATA_AXIS):
+    """Re-form a 1-D mesh on the surviving devices after a peer loss
+    (the elastic layer's shrink planner).  The shrunken mesh keeps the
+    same data axis, so plans re-execute unchanged with fewer shards."""
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = surviving_devices(mesh)
+    return Mesh(np.array(devs), (axis_name,))
+
+
 def shard_batch_arrays(mesh, *arrays, axis_name: str = DATA_AXIS):
     """Place stacked per-partition arrays [n_parts, ...] so the leading
     axis is split across the mesh.  n_parts must equal mesh size."""

@@ -163,71 +163,30 @@ class MultiProcessRunner(DistributedRunner):
             from ..config import FAULT_STAGE_TIMEOUT_MS
 
             deadline_ms = ctx.conf.get(FAULT_STAGE_TIMEOUT_MS)
-        if threads > 1:
+        spec = None
+        if ctx is not None:
+            from .elastic import SpeculationMonitor
+
+            spec = SpeculationMonitor.from_conf(ctx.conf)
+        if threads > 1 or spec is not None:
             # the multi-controller drain loop honors ONE aggregate
             # stage deadline: a wedged decode surfaces TpuStageTimeout
             # (and the leaf re-executes from lineage) instead of
             # blocking this controller's collectives forever while its
-            # peers wait.  Daemon threads, not a ThreadPoolExecutor —
-            # futures workers are joined at interpreter exit, so one
-            # abandoned wedged drain would hang process shutdown, the
-            # exact hang the watchdog exists to prevent.
-            import queue as _queue
-            import threading as _threading
-            import time as _time
+            # peers wait.  The shared collector (elastic.py) adds
+            # straggler speculation on top: a shard whose drain
+            # outlives the rolling latency baseline gets a duplicate
+            # attempt, first result wins, the loser is cancelled.
+            from .elastic import drain_with_speculation
 
-            from ..telemetry import spans as tspans
-
-            box: "_queue.Queue" = _queue.Queue()
-            slots = _threading.Semaphore(threads)
-
-            def worker(p):
-                with slots:
-                    try:
-                        box.put((p, "ok", drain(p)))
-                    except BaseException as e:  # noqa: BLE001
-                        box.put((p, "err", e))
-
-            # drain workers inherit no thread-locals: capture the
-            # telemetry binding once, attach per worker
-            cap = tspans.capture()
-            for p in my_pids:
-                _threading.Thread(target=tspans.bound(cap, worker),
-                                  args=(p,), daemon=True,
-                                  name=f"mp-drain-{p}").start()
-            deadline = (_time.monotonic() + deadline_ms / 1000.0
-                        if deadline_ms > 0 else None)
-            from ..scheduler.cancel import check_cancel
-
-            got = {}
-            while len(got) < len(my_pids):
-                # bounded waits so a cancelled query's collector stops
-                # promptly instead of blocking on the box until every
-                # worker notices on its own
-                check_cancel("leaf.drain")
-                tmo = 0.25 if deadline is None else \
-                    max(0.0, min(0.25, deadline - _time.monotonic()))
-                try:
-                    p, kind, val = box.get(timeout=tmo)
-                except _queue.Empty:
-                    if deadline is None \
-                            or _time.monotonic() < deadline:
-                        continue
-                    from ..fault.errors import TpuStageTimeout
-                    from ..fault.stats import GLOBAL as _fault_stats
-                    from ..telemetry.events import emit_event
-
-                    _fault_stats.add("numWatchdogTrips", 1)
-                    emit_event("watchdog_trip", site="leaf.drain",
-                               timeout_ms=deadline_ms)
-                    raise TpuStageTimeout(
-                        f"multiprocess leaf drain exceeded "
-                        f"fault.stageTimeoutMs={deadline_ms}ms "
-                        f"({len(got)}/{len(my_pids)} splits done)",
-                        site="leaf.drain") from None
-                if kind == "err":
-                    raise val
-                got[p] = val
+            got = drain_with_speculation(
+                my_pids, drain, max_threads=threads,
+                deadline_ms=deadline_ms, site="leaf.drain",
+                monitor=spec,
+                timeout_msg=lambda done, total: (
+                    f"multiprocess leaf drain exceeded "
+                    f"fault.stageTimeoutMs={deadline_ms}ms "
+                    f"({done}/{total} splits done)"))
             per_pid = [got[p] for p in my_pids]
         else:
             per_pid = [drain(p) for p in my_pids]
@@ -253,7 +212,6 @@ class MultiProcessRunner(DistributedRunner):
         string widths come from an allgather of local maxima — the only
         cross-process traffic the leaf costs."""
         import jax
-        from jax.experimental import multihost_utils
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from .. import types as T
@@ -278,15 +236,14 @@ class MultiProcessRunner(DistributedRunner):
         stats = np.asarray([local_rows]
                            + [local_w[ci] for ci in str_cols],
                            dtype=np.int64)
-        # cross-controller collective: poll cancellation BEFORE joining
-        # (a cancelled controller entering an allgather wedges every
-        # peer) and bill the wall to shuffle.collectiveTime
-        from ..scheduler.cancel import check_cancel
-        from ..shuffle.device_shuffle import collective_timer
+        # cross-controller collective through the elastic funnel: it
+        # polls cancellation BEFORE joining (a cancelled controller
+        # entering an allgather wedges every peer), bills the wall to
+        # shuffle.collectiveTime, and aborts with TpuPeerLost on a
+        # dead peer / tripped fault.peer.collectiveTimeoutMs
+        from .elastic import guarded_allgather
 
-        check_cancel("shuffle.collective")
-        with collective_timer():
-            agreed = multihost_utils.process_allgather(stats).max(axis=0)
+        agreed = guarded_allgather(stats).max(axis=0)
         bucket = bucket_rows(max(int(agreed[0]), 1), self.min_bucket)
         widths = {ci: int(w) for ci, w in zip(str_cols, agreed[1:])}
 
@@ -409,16 +366,30 @@ class MultiProcessRunner(DistributedRunner):
         out = trim(stacked)
         return jax.device_put(out, sharding)
 
+    def _try_resume_stage(self, ctx, stage, stages):
+        """Multi-controller runs never resume mid-query: every
+        controller must take the same resume-vs-execute branch or the
+        mesh deadlocks in the next collective, and the per-process
+        recovery stores give no such guarantee.  The elastic shrink
+        path resumes on the surviving single-controller mesh instead
+        (runner.py:_try_resume_stage)."""
+        return None
+
+    def _stage_host_parts(self, out: DeviceBatch):
+        """Stage checkpoints must cover EVERY partition (the surviving
+        process resumes the dead peer's shards from its own store), so
+        gather the non-addressable shards before serializing."""
+        from ..data.column import device_to_host as _d2h
+        from .elastic import guarded_allgather
+
+        gathered = guarded_allgather(out, tiled=True)
+        return [_d2h(p, trim=True)
+                for p in X.unstack_partitions(gathered)]
+
     def _collect_output(self, out: DeviceBatch, stages) -> HostBatch:
-        from jax.experimental import multihost_utils
+        from .elastic import guarded_allgather
 
-        from ..scheduler.cancel import check_cancel
-        from ..shuffle.device_shuffle import collective_timer
-
-        check_cancel("shuffle.collective")
-        with collective_timer():
-            gathered = multihost_utils.process_allgather(out,
-                                                         tiled=True)
+        gathered = guarded_allgather(out, tiled=True)
         # gathered leaves are full global numpy arrays [n, ...]
         parts = X.unstack_partitions(gathered)
         host = [device_to_host(p) for p in parts]
@@ -451,33 +422,91 @@ def _ship_back_events(ctx) -> None:
 def run_distributed_mp(session, df, mesh) -> HostBatch:
     """Execute ``df`` SPMD across every controller process of ``mesh``.
     Must be called by ALL processes with an identically-built plan;
-    returns the full result on every process."""
+    returns the full result on every process.
+
+    This is the elastic entry point of the multi-controller path: the
+    per-query collective deadline and heartbeat ledger are installed
+    here, the unified attempt budget is armed, and a ``TpuPeerLost``
+    escaping the runner re-executes on the shrunken mesh (surviving
+    devices + recovery checkpoints) instead of failing the query."""
+    from ..config import (FAULT_DEGRADE_ENABLED, FAULT_MAX_TOTAL_ATTEMPTS,
+                          FAULT_PEER_COLLECTIVE_TIMEOUT_MS,
+                          RECOVERY_ENABLED)
+    from ..fault.budget import GLOBAL as _budget
+    from ..fault.errors import TpuPeerLost
     from ..plan.physical import ExecContext
+    from . import elastic
     from .collective import make_transport
     from .mesh import DATA_AXIS as _AX
 
     phys = session.physical_plan(df.plan)
     ctx = ExecContext(session.conf, session)
     axis = mesh.axis_names[0] if mesh.axis_names else _AX
+    recovery = None
+    if session.conf.get(RECOVERY_ENABLED):
+        from ..recovery import RecoveryManager
+
+        recovery = RecoveryManager(session.conf)
+        recovery.attach_query(df.plan)
+        recovery.stamp_plan(phys)
+        ctx.recovery = recovery
+    owned = _budget.begin(session.conf.get(FAULT_MAX_TOTAL_ATTEMPTS))
+    ledger = elastic.HeartbeatLedger.from_conf(session.conf)
+    prev_ledger = None
+    if ledger is not None:
+        prev_ledger = elastic.install_heartbeat_ledger(ledger.start())
+    prev_deadline = elastic.install_collective_deadline(
+        session.conf.get(FAULT_PEER_COLLECTIVE_TIMEOUT_MS))
+    shrunk = False
     try:
-        out = MultiProcessRunner(
-            mesh,
-            transport=make_transport(session.conf, axis)).run(phys, ctx)
-        _ship_back_events(ctx)
-        return out
+        try:
+            out = MultiProcessRunner(
+                mesh, transport=make_transport(session.conf, axis)).run(
+                    phys, ctx)
+            _ship_back_events(ctx)
+            return out
+        except TpuPeerLost as e:
+            if not session.conf.get(FAULT_DEGRADE_ENABLED):
+                raise
+            # close the failed attempt's profile BEFORE the rung so
+            # session.last_profile ends up as the completed run's
+            from ..telemetry import finish_query as _finish
+
+            _finish(session, ctx, phys=phys)
+            # the peers are gone (or unreachable): no ship-back, no
+            # further collectives against the old mesh — re-form on
+            # the surviving devices and resume from checkpoints
+            out = elastic.reexecute_on_shrunken_mesh(
+                session, df, mesh, f"{type(e).__name__}: {e}",
+                recovery=recovery)
+            shrunk = True
+            return out
     finally:
+        elastic.install_collective_deadline(prev_deadline)
+        if ledger is not None:
+            elastic.install_heartbeat_ledger(prev_ledger)
+            ledger.stop()
+        budget_snap = _budget.snapshot()  # before end() clears it
+        _budget.end(owned)
         from ..fault.stats import GLOBAL as _fault_stats
 
         from ..shuffle.device_shuffle import GLOBAL as _shuffle_stats
 
         session.last_metrics = dict(
             getattr(session, "last_metrics", None) or {})
-        session.last_metrics.update(_fault_stats.snapshot())
+        if not shrunk:
+            # the shrunken-mesh rung already merged the failed
+            # attempt's counters on top of its own snapshot — a raw
+            # re-snapshot here would clobber the carry
+            session.last_metrics.update(_fault_stats.snapshot())
         # per-run collective wall/bytes (the dispatch wrappers above
         # accrue into the process-global stats; the ExecContext mark
-        # scopes the delta to THIS run)
+        # scopes the delta to THIS run, including any shrunken rerun)
         session.last_metrics.update(_shuffle_stats.metrics_since(
             getattr(ctx, "shuffle_stats_mark", None)))
+        session.last_metrics.update(budget_snap)
+        if recovery is not None:
+            session.last_metrics.update(recovery.metrics())
         from ..telemetry import finish_query
 
         finish_query(session, ctx, phys=phys)

@@ -96,6 +96,15 @@ class _StageRef:
         self.partitioning = partitioning
 
 
+class _ResumedPartitioning:
+    """Sentinel partitioning for a stage output restored from a recovery
+    checkpoint onto a DIFFERENT-size mesh (elastic shrink).  The restored
+    shards no longer satisfy the producing exchange's placement contract,
+    so every distribution-sensitive consumer must repair: hash/range/
+    single checks all reject this sentinel, and joins see an explicit
+    'repair' verdict instead of 'unsupported'."""
+
+
 class _BcastRef:
     """Placeholder for a precomputed (replicated) broadcast build side —
     gathered ONCE per query, reused across capacity retries and stream
@@ -361,15 +370,24 @@ class DistributedRunner:
             from ..config import TASK_THREADS
 
             threads = min(ctx.conf.get(TASK_THREADS), n_parts)
-        if threads > 1:
-            from concurrent.futures import ThreadPoolExecutor
+        spec = None
+        if ctx is not None:
+            from .elastic import SpeculationMonitor
 
-            # pool workers inherit no thread-locals: capture the
-            # telemetry binding here, attach per drain task
-            cap = tspans.capture()
-            with ThreadPoolExecutor(max_workers=threads) as pool:
-                per_pid = list(pool.map(tspans.bound(cap, drain),
-                                        range(n_parts)))
+            spec = SpeculationMonitor.from_conf(ctx.conf)
+        if threads > 1 or spec is not None:
+            # elastic drain collector (elastic.py): same concurrent
+            # semaphore-gated pool as before, plus straggler
+            # speculation when ``speculation.enabled`` — a shard whose
+            # drain outlives the rolling latency baseline gets ONE
+            # duplicate attempt, first result wins, the loser is
+            # cancelled through its own token and unwinds zero-leak
+            from .elastic import drain_with_speculation
+
+            got = drain_with_speculation(
+                list(range(n_parts)), drain, max_threads=threads,
+                site="leaf.drain", monitor=spec)
+            per_pid = [got[p] for p in range(n_parts)]
         else:
             per_pid = [drain(p) for p in range(n_parts)]
 
@@ -643,6 +661,11 @@ class DistributedRunner:
         single_ok = self._is_single(lpart) and self._is_single(rpart)
         if keys_ok or single_ok:
             return "ok"
+        if isinstance(lpart, _ResumedPartitioning) or \
+                isinstance(rpart, _ResumedPartitioning):
+            # checkpoint restored onto a different-size mesh: the old
+            # placement is meaningless, re-exchange both sides
+            return "repair"
         if self._range_keys(lpart) is not None or \
                 self._range_keys(rpart) is not None:
             # range exchanges place rows by their OWN sampled bounds,
@@ -925,9 +948,9 @@ class DistributedRunner:
         import jax
         from jax.sharding import PartitionSpec as P
 
-        from ..scheduler.cancel import check_cancel
         from ..shuffle.device_shuffle import collective_timer
         from ._compat import get_shard_map
+        from .elastic import guarded_call
 
         shard_map = get_shard_map()
 
@@ -972,13 +995,19 @@ class DistributedRunner:
             # same dispatch discipline as exchange_step: a cancelled
             # query must not join a mesh-wide collective its peers
             # will wait on, and the dispatch wall of an
-            # exchange-bearing program accrues to shuffle.collectiveTime
-            check_cancel("shuffle.collective")
+            # exchange-bearing program accrues to shuffle.collectiveTime.
+            # guarded_call layers the elastic deadline/heartbeat watch on
+            # top (fault.peer.collectiveTimeoutMs) so a dead peer turns
+            # into TpuPeerLost instead of an indefinite hang.
             if post is not None or self._has_collective(root):
-                with collective_timer():
-                    out, aux_vals = spmd(*ins)
+                def dispatch(spmd=spmd, ins=tuple(ins)):
+                    with collective_timer():
+                        return spmd(*ins)
+                out, aux_vals = guarded_call(dispatch)
             else:
-                out, aux_vals = spmd(*ins)
+                out, aux_vals = guarded_call(
+                    lambda spmd=spmd, ins=tuple(ins): spmd(*ins),
+                    site="stage.dispatch")
             overflow = False
             for k, v in zip(aux_keys, aux_vals):
                 total = int(np.asarray(v))
@@ -1086,6 +1115,11 @@ class DistributedRunner:
         out = None
         for stage in stages:
             check_cancel(f"runner.stage[{stage.sid}]")
+            resumed = self._try_resume_stage(ctx, stage, stages)
+            if resumed is not None:
+                out = resumed
+                env_stacked[f"stage{stage.sid}"] = out
+                continue
             with tspans.span(f"stage[{stage.sid}]", kind="stage"):
                 out = self._recover(
                     lambda stage=stage: self._run_stage(
@@ -1093,7 +1127,128 @@ class DistributedRunner:
                     ctx, f"stage[{stage.sid}]")
             env_stacked[f"stage{stage.sid}"] = out
             self._record_stage_stats(ctx, stage.sid)
+            self._maybe_checkpoint_stage(ctx, stage, out)
         return self._collect_output(out, stages)
+
+    # ---------------- elastic checkpoint / resume ---------------------
+    def _try_resume_stage(self, ctx, stage, stages):
+        """Restore a checkpointed stage output instead of re-executing
+        it (the elastic re-execution path).  The checkpoint may come
+        from a previous attempt of the SAME query on a LARGER mesh — a
+        peer died and the surviving devices re-formed — in which case
+        the checkpointed partitions are folded onto this mesh
+        (``p -> p % n``) and every later consumer of the stage sees a
+        ``_ResumedPartitioning`` sentinel, forcing a repair
+        re-exchange: placement is re-derived, never assumed."""
+        rec = getattr(ctx, "recovery", None)
+        root = stage.root
+        if rec is None or not isinstance(root, tuple):
+            return None
+        rfp = getattr(root[0], "_recovery_fp", None)
+        if rfp is None:
+            return None
+        from ..native import serializer
+        from ..plan.physical import _empty_batch
+        from ..recovery.manager import schema_signature
+
+        exch = root[0]
+        schema = exch.schema
+        res = rec.try_resume(rfp, n_out=None,
+                             schema_sig=schema_signature(schema))
+        if res is None:
+            return None
+        m, frames = res
+        n_ck = int(m.get("n_out", len(frames)))
+        try:
+            per_shard: List[List[HostBatch]] = \
+                [[] for _ in range(self.n)]
+            for p, plist in enumerate(frames):
+                for frame in plist:
+                    hb = serializer.deserialize(frame, schema)
+                    if hb.num_rows:
+                        per_shard[p % self.n].append(hb)
+            shards = [HostBatch.concat(bs) if bs
+                      else _empty_batch(schema)
+                      for bs in per_shard]
+            placed = self._place(self._stack_host(shards))
+        except Exception as e:  # noqa: BLE001 — re-execute, never fail
+            rec.disable(f"stage resume failed "
+                        f"({type(e).__name__}: {e})")
+            return None
+        if n_ck != self.n:
+            mark = _ResumedPartitioning()
+            for st in stages:
+                self._mark_resumed_refs(st.root, stage.sid, mark)
+        return placed
+
+    def _mark_resumed_refs(self, node, sid: int, mark) -> None:
+        """Stamp the resumed-partitioning sentinel on every _StageRef
+        of stage ``sid`` (the restored output's placement contract is
+        void on a different-size mesh)."""
+        if isinstance(node, _StageRef):
+            if node.stage_id == sid:
+                node.partitioning = mark
+            return
+        if isinstance(node, _BcastRef):
+            self._mark_resumed_refs(node.op, sid, mark)
+            return
+        if isinstance(node, tuple):
+            for kid in node[1:]:
+                self._mark_resumed_refs(kid, sid, mark)
+
+    def _maybe_checkpoint_stage(self, ctx, stage, out) -> None:
+        """Persist a completed stage's post-exchange output as a
+        durable checkpoint — the distributed analogue of the local
+        exchange's ``_maybe_checkpoint`` (exec/exchange.py), keyed by
+        the SAME exchange fingerprint so a surviving mesh can resume
+        what a lost one produced.  Serialization runs under the
+        injection shield (a fault drill must not fire inside framework
+        persistence) and any failure disables checkpointing for the
+        rest of the query instead of failing it."""
+        rec = getattr(ctx, "recovery", None)
+        root = stage.root
+        if rec is None or not isinstance(root, tuple):
+            return
+        rfp = getattr(root[0], "_recovery_fp", None)
+        if rfp is None or not rec.should_checkpoint(rfp):
+            return
+        from ..fault import injector as F
+        from ..native import serializer
+        from ..recovery.manager import schema_signature
+
+        exch = root[0]
+        frames: List[List] = []
+        try:
+            with F._shield():
+                for hb in self._stage_host_parts(out):
+                    plist = []
+                    if hb.num_rows:
+                        plist.append((serializer.serialize(hb),
+                                      hb.num_rows))
+                    frames.append(plist)
+        except Exception as e:  # noqa: BLE001
+            rec.disable(f"stage checkpoint read-back failed "
+                        f"({type(e).__name__}: {e})")
+            return
+        written = rec.checkpoint_exchange(
+            rfp, schema_sig=schema_signature(exch.schema),
+            n_out=len(frames),
+            part_rows=[sum(r for _f, r in plist) for plist in frames],
+            total_bytes=sum(int(f.nbytes) for plist in frames
+                            for f, _r in plist),
+            partitioning=type(exch.partitioning).__name__,
+            frames=frames)
+        if written:
+            from ..shuffle.device_shuffle import GLOBAL as _DS
+
+            _DS.add("checkpointBytes", written)
+
+    def _stage_host_parts(self, out: DeviceBatch) -> List[HostBatch]:
+        """One trimmed HostBatch per mesh partition of a stacked stage
+        output (overridden by the multi-process runner, which must
+        gather non-addressable shards first)."""
+        return [device_to_host(p, trim=True)
+                for p in X.unstack_partitions(out)]
 
     def _record_stage_stats(self, ctx, sid: int) -> None:
         """Record the stage's per-shard row histogram from _retile's
@@ -1146,25 +1301,39 @@ class DistributedRunner:
         raise DistributedUnsupported("schema of stage ref")
 
 
-def run_distributed(session, df, mesh=None, n_devices: int = 8
-                    ) -> HostBatch:
+def run_distributed(session, df, mesh=None, n_devices: int = 8,
+                    recovery=None) -> HostBatch:
     """Convenience: plan ``df`` through the session's rewrite pipeline
-    and execute it SPMD over ``mesh`` (or a fresh n-device mesh)."""
+    and execute it SPMD over ``mesh`` (or a fresh n-device mesh).
+
+    ``recovery``: an already-attached RecoveryManager (the elastic
+    shrunken-mesh rung passes the failed attempt's manager here so
+    completed stages resume from its checkpoints instead of
+    re-executing).  When None, no stage checkpointing happens — the
+    behaviour existing callers rely on."""
+    from ..config import FAULT_PEER_COLLECTIVE_TIMEOUT_MS
     from ..plan.physical import ExecContext
     from .mesh import make_mesh
 
+    from . import elastic
     from .collective import make_transport
     from .mesh import DATA_AXIS as _AX
 
     mesh = mesh or make_mesh(n_devices)
     phys = session.physical_plan(df.plan)
     ctx = ExecContext(session.conf, session)
+    if recovery is not None:
+        recovery.stamp_plan(phys)
+        ctx.recovery = recovery
     axis = mesh.axis_names[0] if mesh.axis_names else _AX
+    prev_deadline = elastic.install_collective_deadline(
+        session.conf.get(FAULT_PEER_COLLECTIVE_TIMEOUT_MS))
     try:
         return DistributedRunner(
             mesh,
             transport=make_transport(session.conf, axis)).run(phys, ctx)
     finally:
+        elastic.install_collective_deadline(prev_deadline)
         # the fault counters must be visible even on a direct
         # run_distributed call (the ladder driver re-merges on top)
         session.last_metrics = dict(
@@ -1174,6 +1343,8 @@ def run_distributed(session, df, mesh=None, n_devices: int = 8
 
         session.last_metrics.update(_shuffle_stats.metrics_since(
             getattr(ctx, "shuffle_stats_mark", None)))
+        if recovery is not None:
+            session.last_metrics.update(recovery.metrics())
         from ..telemetry import finish_query
 
         # profile metrics default to THIS query's ctx snapshot — the

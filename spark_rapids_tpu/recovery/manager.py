@@ -214,7 +214,7 @@ class RecoveryManager:
         return stamped
 
     # ----- resume ----------------------------------------------------------
-    def try_resume(self, exchange_fp: str, *, n_out: int,
+    def try_resume(self, exchange_fp: str, *, n_out: Optional[int],
                    schema_sig: List[str]
                    ) -> Optional[Tuple[Dict, List[List[np.ndarray]]]]:
         """Return ``(manifest, frames_per_partition)`` when a VALID
@@ -223,7 +223,12 @@ class RecoveryManager:
         caller skips the exchange's child entirely, so there is no
         later fallback point.  Any invalidity quarantines the
         checkpoint (event + rename aside) and returns None: full
-        re-execution, never a wrong answer."""
+        re-execution, never a wrong answer.
+
+        ``n_out=None`` is the fan-out WILDCARD for elastic resume on a
+        different-size mesh (the shrunken-mesh rung): the manifest's
+        own partition count is accepted and the caller re-maps the
+        checkpointed partitions onto its mesh."""
         if self.query_fp is None:
             return None
         if not self.resume_enabled \
@@ -242,14 +247,17 @@ class RecoveryManager:
                 raise ValueError("query fingerprint mismatch")
             if m.get("schema") != list(schema_sig):
                 raise ValueError("schema signature mismatch")
-            if int(m.get("n_out", -1)) != int(n_out):
+            load_n = int(m.get("n_out", -1))
+            if load_n < 0:
+                raise ValueError("manifest missing n_out")
+            if n_out is not None and load_n != int(n_out):
                 raise ValueError(
                     f"fan-out mismatch: {m.get('n_out')} != {n_out}")
             if m.get("conf") != self._conf_snapshot:
                 raise ValueError(
                     "result-affecting conf changed since checkpoint: "
                     f"{m.get('conf')} != {self._conf_snapshot}")
-            frames = self.store.load_frames(d, m, n_out)
+            frames = self.store.load_frames(d, m, load_n)
         except Exception as e:  # noqa: BLE001 - ANY doubt quarantines
             moved = self.store.quarantine(d)
             self._counters["numQuarantined"] += 1
@@ -263,7 +271,7 @@ class RecoveryManager:
             return None
         self._counters["numStagesResumed"] += 1
         emit_event("checkpoint_resume", exchange=exchange_fp,
-                   partitions=n_out,
+                   partitions=load_n,
                    rows=int(m.get("total_rows", 0)),
                    bytes=int(m.get("total_bytes", 0)))
         return m, frames

@@ -112,6 +112,26 @@ def current() -> Optional[CancelToken]:
     return getattr(_tl, "token", None)
 
 
+class activated:
+    """Scope that binds *token* to the current thread and restores the
+    previous binding on exit — for worker threads (e.g. speculative
+    drain attempts) that need a private token without clobbering the
+    query token bound by their spawner."""
+
+    def __init__(self, token: Optional[CancelToken]):
+        self._token = token
+        self._prev: Optional[CancelToken] = None
+
+    def __enter__(self):
+        self._prev = current()
+        activate(self._token)
+        return self._token
+
+    def __exit__(self, *exc):
+        activate(self._prev)
+        return False
+
+
 def check_cancel(site: str = "") -> None:
     """Poll the current thread's cancel token; no-op when unbound.
 

@@ -38,6 +38,17 @@ noteworthy engine transition emits one flat JSON record:
 ``attempt_budget_exhausted`` — the per-query ``fault.maxTotalAttempts``
                        ceiling was crossed; carries the full attempt
                        ledger (terminal, emitted exactly once),
+``peer_lost``        — a peer worker process was declared dead (missed
+                       heartbeats, a tripped collective deadline, or
+                       the ``peer_crash`` injector),
+``mesh_shrink``      — the elastic layer re-formed the mesh on the
+                       surviving devices; carries ``n_before`` /
+                       ``n_after`` / ``cause``,
+``speculative_attempt`` — a straggling shard's drain outlived the
+                       speculation baseline and a duplicate attempt
+                       was launched,
+``speculative_win``  — a speculative duplicate finished before its
+                       straggling primary; the primary was cancelled,
 ``overload_enter`` / ``overload_exit`` — the scheduler's
                        OverloadMonitor crossed (or, with hysteresis,
                        recovered from) the ``scheduler.overload.*``
@@ -111,6 +122,9 @@ EVENT_CATALOG = frozenset({
     "aqe_stage_stats", "aqe_broadcast_join", "aqe_skew_split",
     "aqe_coalesce_partitions", "aqe_reservation_rebase",
     "aqe_final_plan",
+    # elastic multi-host (parallel/elastic.py)
+    "peer_lost", "mesh_shrink", "speculative_attempt",
+    "speculative_win",
     # durable checkpoints
     "checkpoint_write", "checkpoint_resume", "checkpoint_quarantine",
     "checkpoint_disabled",
@@ -268,22 +282,26 @@ def gather_multiprocess_events(local_events: List[Dict]) -> List[Dict]:
     same contract as the stage programs); lengths are agreed through a
     small allgather first, payloads padded to the maximum."""
     import numpy as np
-    from jax.experimental import multihost_utils
 
     import jax
+
+    # the elastic guard is the ONE process_allgather funnel: a dead
+    # peer must abort the ship-back like any other collective
+    from ..parallel.elastic import guarded_allgather
 
     nprocs = jax.process_count()
     if nprocs <= 1:
         return []  # no peers to ship from
     payload = np.frombuffer(
         json.dumps(local_events).encode("utf-8"), dtype=np.uint8)
-    sizes = multihost_utils.process_allgather(
-        np.asarray([payload.size], dtype=np.int64))
+    sizes = guarded_allgather(
+        np.asarray([payload.size], dtype=np.int64),
+        site="telemetry.shipback")
     maxlen = max(int(np.asarray(sizes).max()), 1)
     padded = np.zeros(maxlen, dtype=np.uint8)
     padded[:payload.size] = payload
     gathered = np.asarray(
-        multihost_utils.process_allgather(padded)).reshape(
+        guarded_allgather(padded, site="telemetry.shipback")).reshape(
             nprocs, maxlen)
     me = jax.process_index()
     out: List[Dict] = []

@@ -1,0 +1,104 @@
+"""Worker entry for the elastic SIGKILL drill (NOT pytest).
+
+Two controller processes run the SAME seeded q3-shaped plan (shuffled
+join + group agg + sort) through ``run_distributed_mp`` with the elastic
+protocol armed: heartbeat ledger, collective deadline, recovery
+checkpoints.  Worker 1 arms ``recovery.killAfterCheckpoints=1`` — it
+SIGKILLs itself the instant its first stage checkpoint commits, exactly
+like a machine losing power mid-query.  Worker 0 must then:
+
+* detect the loss (heartbeat staleness or a transport error confirmed
+  against the ledger) as ``TpuPeerLost`` instead of wedging in the next
+  collective,
+* re-form the mesh on its own surviving devices,
+* resume the checkpointed stage from its local recovery store
+  (``numStagesResumed >= 1`` — the stage checkpoint gathered every
+  peer's shards before the crash), and
+* finish the query bit-identical to the single-process CPU oracle.
+
+Run by tests/test_elastic_mp.py as:
+
+    python tests/mp_elastic_worker.py <coordinator> <nprocs> <pid> \
+        <heartbeat_dir> <recovery_root>
+"""
+import os
+import sys
+
+
+def main():
+    coordinator, nprocs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    hb_dir, recovery_root = sys.argv[4], sys.argv[5]
+
+    from spark_rapids_tpu.parallel.multiprocess import (
+        init_multiprocess, run_distributed_mp)
+
+    mesh = init_multiprocess(coordinator, nprocs, pid,
+                             local_cpu_devices=4)
+
+    import numpy as np
+
+    from spark_rapids_tpu import Session
+    from spark_rapids_tpu.plan import functions as F
+
+    conf = {
+        "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+        "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+        "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+        # per-worker recovery stores: the survivor resumes from its OWN
+        # checkpoints (each stage checkpoint gathers all shards first)
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.recovery.dir": os.path.join(
+            recovery_root, f"w{pid}"),
+        # elastic protocol: fast heartbeats so the drill detects the
+        # SIGKILL in ~1s, and a generous collective deadline as the
+        # backstop
+        "spark.rapids.tpu.fault.peer.heartbeatMs": 200,
+        "spark.rapids.tpu.fault.peer.missedHeartbeats": 5,
+        "spark.rapids.tpu.fault.peer.heartbeatDir": hb_dir,
+        "spark.rapids.tpu.fault.peer.collectiveTimeoutMs": 30000,
+    }
+    if pid == 1:
+        # die HARD right after the first stage checkpoint commits
+        conf["spark.rapids.tpu.recovery.killAfterCheckpoints"] = 1
+
+    rng = np.random.RandomState(123)
+    orders = {"o_custkey": rng.randint(0, 60, 500),
+              "o_total": (rng.rand(500) * 1000).round(6)}
+    cust = {"c_custkey": np.arange(60),
+            "c_nation": rng.randint(0, 6, 60)}
+
+    def q(sess):
+        o = sess.create_dataframe(dict(orders))
+        c = sess.create_dataframe(dict(cust))
+        j = o.join(c, on=(["o_custkey"], ["c_custkey"]), how="inner")
+        return j.group_by("c_nation").agg(
+            F.sum("o_total").alias("rev"),
+            F.count("o_total").alias("n")).sort(F.col("rev").desc())
+
+    sess = Session(conf)
+    got = run_distributed_mp(sess, q(sess), mesh).to_rows()
+
+    # only the survivor reaches here (worker 1 is SIGKILLed mid-query)
+    cpu = Session(tpu_enabled=False)
+    want = q(cpu).collect()
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got, want):  # ORDERED compare: the sort must hold
+        assert g[0] == w[0] and g[2] == w[2], (g, w)
+        assert abs(g[1] - w[1]) < 1e-6 * max(1.0, abs(w[1])), (g, w)
+
+    m = sess.last_metrics
+    assert m.get("fault.numPeerLost", 0) >= 1, m
+    assert m.get("fault.numMeshShrinks", 0) >= 1, m
+    assert m.get("recovery.numStagesResumed", 0) >= 1, m
+    assert m.get("fault.totalAttempts", 0) >= 1, m
+    print(f"MPE RESULT OK pid={pid} rows={len(got)} "
+          f"peerLost={m.get('fault.numPeerLost')} "
+          f"shrinks={m.get('fault.numMeshShrinks')} "
+          f"resumed={m.get('recovery.numStagesResumed')}", flush=True)
+    # skip jax.distributed teardown: the shutdown barrier would wedge
+    # against the SIGKILLed peer
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

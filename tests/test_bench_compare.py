@@ -132,3 +132,42 @@ def test_cli_compare_only_mode_never_runs_the_bench(tmp_path):
         capture_output=True, text=True, timeout=60, env=env)
     assert bad.returncode == 1, bad.stdout + bad.stderr
     assert "wall_per_dispatch_s" in bad.stdout
+
+
+def _multichip(**elastic):
+    tail = ("entry ok: ...\n"
+            "MULTICHIP_ELASTIC " + json.dumps({
+                "degraded_devices": 4, "respeculated_shards": 1,
+                "mesh_shrink_count": 1, "stages_resumed": 4,
+                **elastic}) + "\n"
+            "dryrun ok (virtual 8-device cpu mesh)\n")
+    return {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+            "tail": tail}
+
+
+def test_elastic_fields_parsed_from_multichip_tail():
+    got = bench._elastic_summary(_multichip())
+    assert got == {"degraded_devices": 4, "respeculated_shards": 1,
+                   "mesh_shrink_count": 1}
+    assert bench._elastic_summary({"tail": "no marker here"}) is None
+    assert bench._elastic_summary(_summary()) is None
+
+
+def test_elastic_drill_self_compare_clean_and_regressions_flagged():
+    base = _multichip()
+    assert bench.compare_summaries(base, copy.deepcopy(base)) == []
+    # the drill DELIBERATELY kills a peer: detection regressing to
+    # zero is the failure mode the gate must catch
+    dead = bench.compare_summaries(base, _multichip(mesh_shrink_count=0))
+    assert [r["field"] for r in dead] == ["mesh_shrink_count"]
+    assert dead[0]["query"] == "elastic_drill"
+    nospec = bench.compare_summaries(
+        base, _multichip(respeculated_shards=0))
+    assert [r["field"] for r in nospec] == ["respeculated_shards"]
+    # losing MORE devices than the baseline is also a regression ...
+    worse = bench.compare_summaries(base, _multichip(degraded_devices=6))
+    assert [r["field"] for r in worse] == ["degraded_devices"]
+    # ... but shrinking less / respeculating more is an improvement
+    assert bench.compare_summaries(
+        base, _multichip(degraded_devices=2,
+                         respeculated_shards=3)) == []

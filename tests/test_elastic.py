@@ -1,0 +1,369 @@
+"""Elastic multi-host execution (spark_rapids_tpu/parallel/elastic.py).
+
+The elastic invariant: a peer process that dies or stalls mid-query
+must never wedge the surviving mesh — heartbeat staleness or a tripped
+``fault.peer.collectiveTimeoutMs`` surfaces as ``TpuPeerLost``, the
+mesh re-forms on the survivors, completed stages resume from recovery
+checkpoints, and the answer stays bit-identical to a fault-free run.
+Straggling shards get ONE speculative duplicate: first result wins,
+the loser is cancelled and unwinds with the zero-leak discipline.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.fault import fault_stats
+from spark_rapids_tpu.fault.errors import TpuPeerLost
+from spark_rapids_tpu.parallel import elastic
+from spark_rapids_tpu.plan import functions as F
+
+FAST = {
+    "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+    "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+}
+
+
+def _norm(rows):
+    return sorted(
+        (tuple((None if v is None else
+                (round(v, 9) if isinstance(v, float) else v))
+               for v in r) for r in rows),
+        key=repr)
+
+
+# ==========================================================================
+# Heartbeat ledger
+# ==========================================================================
+def test_heartbeat_ledger_detects_stale_and_missing_peers(tmp_path):
+    led = elastic.HeartbeatLedger(str(tmp_path), 0, 2,
+                                  heartbeat_ms=50, missed_limit=3)
+    # before start() the ledger must stay silent: a worker that has
+    # not begun heartbeating has no business declaring peers dead
+    assert led.lost_peers() == ()
+    led.start()
+    try:
+        # missing peer file inside the startup grace: not lost yet
+        assert led.lost_peers() == ()
+        peer = os.path.join(str(tmp_path), "hb-1")
+        with open(peer, "a"):
+            pass
+        assert led.lost_peers() == ()
+        # stale mtime past heartbeat_ms * missed_limit: lost
+        past = time.time() - 10.0
+        os.utime(peer, (past, past))
+        assert led.lost_peers() == (1,)
+        # file vanished AND the startup grace expired: lost
+        os.remove(peer)
+        led._start_wall -= 10.0
+        assert led.lost_peers() == (1,)
+        # our own heartbeat file is kept fresh by the beat thread
+        own = os.path.join(str(tmp_path), "hb-0")
+        time.sleep(0.2)
+        assert time.time() - os.stat(own).st_mtime < 5.0
+    finally:
+        led.stop()
+
+
+def test_make_shrunken_mesh_halves_single_controller_mesh():
+    from spark_rapids_tpu.parallel.mesh import (make_mesh,
+                                                make_shrunken_mesh)
+
+    mesh = make_mesh(8)
+    small = make_shrunken_mesh(mesh)
+    assert small.axis_names == mesh.axis_names
+    devs, sdevs = list(mesh.devices.flat), list(small.devices.flat)
+    assert len(sdevs) == 4
+    assert [d.id for d in sdevs] == [d.id for d in devs[:4]]
+
+
+# ==========================================================================
+# Deadline-guarded collective dispatch
+# ==========================================================================
+def test_guarded_call_is_direct_when_nothing_armed():
+    prev = elastic.install_collective_deadline(0)
+    try:
+        assert elastic.installed_heartbeat_ledger() is None
+        assert elastic.guarded_call(lambda: 42) == 42
+    finally:
+        elastic.install_collective_deadline(prev)
+
+
+def test_guarded_call_deadline_aborts_with_peer_lost(monkeypatch):
+    events = []
+    monkeypatch.setattr(
+        elastic, "emit_event",
+        lambda name, **kw: events.append((name, kw)))
+    release = threading.Event()
+    epoch0 = elastic.collective_epoch()
+    lost0 = fault_stats.get("numPeerLost")
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(TpuPeerLost) as ei:
+            elastic.guarded_call(lambda: release.wait(30),
+                                 site="test.collective",
+                                 timeout_ms=300)
+    finally:
+        release.set()
+    assert time.monotonic() - t0 < 10.0, "must abandon, not wait out"
+    assert "collectiveTimeoutMs" in str(ei.value)
+    # the loss is counted, announced and aborts sibling dispatches
+    assert fault_stats.get("numPeerLost") == lost0 + 1
+    assert elastic.collective_epoch() == epoch0 + 1
+    lost_events = [kw for name, kw in events if name == "peer_lost"]
+    assert len(lost_events) == 1
+    assert "collectiveTimeoutMs" in lost_events[0]["reason"]
+
+
+def test_guarded_call_ledger_staleness_aborts(monkeypatch, tmp_path):
+    monkeypatch.setattr(elastic, "emit_event", lambda *a, **k: None)
+    led = elastic.HeartbeatLedger(str(tmp_path), 0, 2,
+                                  heartbeat_ms=20, missed_limit=2)
+    led.start()
+    led._start_wall -= 60.0  # peer 1 never wrote: grace long expired
+    prev = elastic.install_heartbeat_ledger(led)
+    release = threading.Event()
+    try:
+        with pytest.raises(TpuPeerLost, match="stopped heartbeating"):
+            elastic.guarded_call(lambda: release.wait(30),
+                                 site="test.collective")
+    finally:
+        release.set()
+        elastic.install_heartbeat_ledger(prev)
+        led.stop()
+
+
+def test_abort_collectives_unwinds_in_flight_dispatch():
+    release = threading.Event()
+    caught = []
+
+    def call():
+        try:
+            elastic.guarded_call(lambda: release.wait(30),
+                                 site="test.collective",
+                                 timeout_ms=60000)
+        except BaseException as e:  # noqa: BLE001
+            caught.append(e)
+
+    t = threading.Thread(target=call, daemon=True)
+    t.start()
+    time.sleep(0.4)  # let the dispatch enter its collector loop
+    try:
+        elastic.abort_collectives("test epoch bump")
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert len(caught) == 1
+        assert isinstance(caught[0], TpuPeerLost)
+        assert "epoch bump" in str(caught[0])
+    finally:
+        release.set()
+
+
+# ==========================================================================
+# Straggler speculation
+# ==========================================================================
+def test_speculation_first_result_wins_and_loser_unwinds():
+    from spark_rapids_tpu.scheduler.cancel import (TpuQueryCancelled,
+                                                   check_cancel)
+
+    mon = elastic.SpeculationMonitor(multiplier=1.0, quantile=50.0,
+                                     min_samples=2, min_latency_ms=1.0)
+    mon.observe(5.0)
+    mon.observe(5.0)
+    calls = {}
+    unwound = []
+
+    def drain(pid):
+        n = calls.get(pid, 0)
+        calls[pid] = n + 1
+        if pid == 0:
+            return "ok0"
+        if n == 0:
+            # primary straggler: spin at the cancellation checkpoint
+            # until the speculative sibling wins and cancels us
+            try:
+                while True:
+                    time.sleep(0.005)
+                    check_cancel("test.drain")
+            except TpuQueryCancelled:
+                unwound.append("primary")
+                raise
+        return "fast"
+
+    wins0 = fault_stats.get("numSpeculativeWins")
+    got = elastic.drain_with_speculation(
+        [0, 1], drain, max_threads=2, site="test.drain", monitor=mon)
+    assert got == {0: "ok0", 1: "fast"}
+    assert fault_stats.get("numSpeculativeWins") == wins0 + 1
+    # zero-leak: the cancelled primary unwinds through its own except
+    deadline = time.monotonic() + 5.0
+    while not unwound and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert unwound == ["primary"]
+
+
+def test_speculation_emits_attempt_and_win_events(monkeypatch):
+    events = []
+    monkeypatch.setattr(
+        elastic, "emit_event",
+        lambda name, **kw: events.append((name, kw)))
+    mon = elastic.SpeculationMonitor(multiplier=1.0, quantile=50.0,
+                                     min_samples=2, min_latency_ms=1.0)
+    mon.observe(5.0)
+    mon.observe(5.0)
+    calls = {}
+    release = threading.Event()
+
+    def drain(pid):
+        n = calls.get(pid, 0)
+        calls[pid] = n + 1
+        if n == 0:
+            release.wait(30)  # primary blocks until the test ends
+            return "slow"
+        return "fast"
+
+    try:
+        got = elastic.drain_with_speculation(
+            [7], drain, max_threads=1, site="test.drain", monitor=mon)
+    finally:
+        release.set()
+    assert got == {7: "fast"}
+    names = [name for name, _ in events]
+    assert names.count("speculative_attempt") == 1
+    assert names.count("speculative_win") == 1
+    att = [kw for n, kw in events if n == "speculative_attempt"][0]
+    assert att["shard"] == 7 and att["elapsed_ms"] > att["baseline_ms"]
+
+
+def test_speculation_monitor_gates_on_samples_and_floor():
+    mon = elastic.SpeculationMonitor(multiplier=2.0, quantile=95.0,
+                                     min_samples=4, min_latency_ms=50.0)
+    assert not mon.should_speculate(10000.0), "no samples yet"
+    for _ in range(4):
+        mon.observe(10.0)
+    assert not mon.should_speculate(45.0), "under the floor"
+    assert mon.should_speculate(55.0)
+
+
+# ==========================================================================
+# The shrunken-mesh rung: peer crash -> mesh shrink -> checkpoint resume
+# ==========================================================================
+def _elastic_query(sess):
+    rng = np.random.RandomState(11)
+    facts = sess.create_dataframe({
+        "k": rng.randint(0, 16, 240).tolist(),
+        "v": [round(float(x), 6) for x in rng.rand(240) * 50]},
+        n_partitions=8)
+    dims = sess.create_dataframe({
+        "dk": list(range(16)),
+        "w": [round(float(x), 6) for x in rng.rand(16) * 10]},
+        n_partitions=8)
+    j = facts.join(dims, on=(["k"], ["dk"]), how="inner")
+    return j.group_by("k").agg(F.sum("v").alias("s"),
+                               F.count("w").alias("c"))
+
+
+def _elastic_conf(extra=None):
+    conf = dict(FAST)
+    conf["spark.rapids.tpu.sql.broadcastSizeThreshold"] = 0
+    conf.update(extra or {})
+    return conf
+
+
+def _count_stage_runs():
+    """How many ``stage.run`` checkpoints one clean execution of the
+    drill query polls (site-filtered counting on a never-firing nth
+    injector) — the deterministic knob for crashing the LAST stage."""
+    from spark_rapids_tpu.fault.injector import get_fault_injector
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel.runner import run_distributed
+
+    sess = srt.Session(_elastic_conf({
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "peer_crash",
+        "spark.rapids.tpu.fault.injection.site": "stage.run",
+        "spark.rapids.tpu.fault.injection.skipCount": 10 ** 6,
+    }))
+    out = run_distributed(sess, _elastic_query(sess), mesh=make_mesh(8))
+    return get_fault_injector().checkpoints_seen, _norm(out.to_rows())
+
+
+@pytest.mark.fault_injection
+def test_peer_crash_shrinks_mesh_and_resumes_from_checkpoints(tmp_path):
+    """An injected peer crash on the LAST stage re-forms the mesh on
+    the surviving half, resumes every completed stage from recovery
+    checkpoints (numStagesResumed > 0), and the answer is bit-identical
+    — without ever touching the single-process degradation rung."""
+    from spark_rapids_tpu.fault.ladder import run_with_fault_tolerance
+
+    n_runs, clean = _count_stage_runs()
+    assert n_runs >= 2, "drill query must be multi-stage"
+    sess = srt.Session(_elastic_conf({
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.recovery.dir": str(tmp_path),
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "peer_crash",
+        "spark.rapids.tpu.fault.injection.site": "stage.run",
+        "spark.rapids.tpu.fault.injection.skipCount": n_runs - 1,
+    }))
+    out = run_with_fault_tolerance(sess, _elastic_query(sess),
+                                   n_devices=8)
+    assert _norm(out.to_rows()) == clean
+    m = sess.last_metrics
+    assert m.get("fault.numMeshShrinks", 0) >= 1, m
+    assert m.get("recovery.numStagesResumed", 0) >= 1, m
+    assert m.get("recovery.numCheckpointsWritten", 0) >= 1, m
+    # the shrunken rung finished the query: no single-process degrade
+    assert m.get("fault.degradeLevel", 0) == 0, m
+    # the extra rung is charged to the unified attempt budget
+    assert m.get("fault.totalAttempts", 0) >= 1, m
+
+
+@pytest.mark.fault_injection
+def test_peer_crash_without_degrade_enabled_raises(tmp_path):
+    from spark_rapids_tpu.fault.ladder import run_with_fault_tolerance
+
+    sess = srt.Session(_elastic_conf({
+        "spark.rapids.tpu.fault.degrade.enabled": False,
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "peer_crash",
+        "spark.rapids.tpu.fault.injection.site": "stage.run",
+        "spark.rapids.tpu.fault.injection.skipCount": 0,
+    }))
+    with pytest.raises(TpuPeerLost):
+        run_with_fault_tolerance(sess, _elastic_query(sess), n_devices=8)
+
+
+@pytest.mark.fault_injection
+def test_peer_stall_speculation_wins_in_distributed_drain():
+    """A ``peer_stall`` straggler injected at the leaf drain arms one
+    speculative duplicate whose result wins (speculative_win >= 1) and
+    the query completes bit-identical without any mesh shrink."""
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel.runner import run_distributed
+
+    def run(extra):
+        sess = srt.Session(_elastic_conf(extra))
+        out = run_distributed(sess, _elastic_query(sess),
+                              mesh=make_mesh(8))
+        return sess, _norm(out.to_rows())
+
+    _, clean = run({})
+    sess, got = run({
+        "spark.rapids.tpu.speculation.enabled": True,
+        "spark.rapids.tpu.speculation.minSamples": 3,
+        "spark.rapids.tpu.speculation.multiplier": 2.0,
+        "spark.rapids.tpu.speculation.minLatencyMs": 200.0,
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": "peer_stall",
+        "spark.rapids.tpu.fault.injection.site": "leaf.drain",
+        "spark.rapids.tpu.fault.injection.skipCount": 6,
+        "spark.rapids.tpu.fault.injection.delayMs": 30000.0,
+    })
+    assert got == clean
+    m = sess.last_metrics
+    assert m.get("fault.numSpeculativeWins", 0) >= 1, m
+    assert m.get("fault.numMeshShrinks", 0) == 0, m
