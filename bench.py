@@ -656,6 +656,11 @@ def _wall_per_dispatch(row):
 ELASTIC_FIELDS = ("degraded_devices", "respeculated_shards",
                   "mesh_shrink_count")
 
+#: absolute floor for warm-p50 serving regressions: cache hits land in
+#: single-digit milliseconds, where scheduler jitter easily exceeds the
+#: relative threshold without meaning anything
+SERVING_P50_FLOOR_MS = 50.0
+
 
 def _elastic_summary(art):
     """The elastic drill counters of a MULTICHIP artifact, or None.
@@ -702,6 +707,16 @@ def compare_summaries(old, new, threshold=0.20):
     baseline shrank, no speculative win where the baseline
     respeculated) or MORE devices degraded than the baseline are
     regressions.
+
+    SERVING artifacts (``bench_serving.py`` — rounds carrying a
+    ``warm`` replay phase) additionally diff the serving caches:
+    per-tier warm p50 past the threshold AND a
+    ``SERVING_P50_FLOOR_MS`` absolute floor (sub-floor jitter on
+    single-digit-millisecond cache hits is noise, not regression), and
+    lost cache-hit coverage — a ``cache_hit_rate`` that fell more than
+    ``threshold`` below the baseline's means submissions that used to
+    be served from the cache are executing again.  Artifacts without
+    serving rounds skip this section entirely.
     """
     ov, nv = old.get("schema_version"), new.get("schema_version")
     if ov != nv:
@@ -755,6 +770,34 @@ def compare_summaries(old, new, threshold=0.20):
                     (bad_when == "grew" and v > b):
                 regs.append({"query": "elastic_drill", "field": field,
                              "old": b, "new": v})
+    old_rounds = old.get("rounds") if isinstance(old.get("rounds"),
+                                                 dict) else {}
+    new_rounds = new.get("rounds") if isinstance(new.get("rounds"),
+                                                 dict) else {}
+    for mode in sorted(set(old_rounds) & set(new_rounds)):
+        ow = (old_rounds[mode] or {}).get("warm") \
+            if isinstance(old_rounds[mode], dict) else None
+        nw = (new_rounds[mode] or {}).get("warm") \
+            if isinstance(new_rounds[mode], dict) else None
+        if not isinstance(ow, dict) or not isinstance(nw, dict):
+            continue
+        o_tiers = ow.get("per_tier") or {}
+        n_tiers = nw.get("per_tier") or {}
+        for tier in sorted(set(o_tiers) & set(n_tiers)):
+            b = (o_tiers[tier] or {}).get("p50_ms")
+            v = (n_tiers[tier] or {}).get("p50_ms")
+            if isinstance(b, (int, float)) and isinstance(v, (int, float)) \
+                    and b > 0 and v > b * limit \
+                    and v - b > SERVING_P50_FLOOR_MS:
+                regs.append({"query": f"serving.{mode}.{tier}",
+                             "field": "warm_p50_ms",
+                             "old": b, "new": v,
+                             "ratio": round(v / b, 2)})
+        b, v = ow.get("cache_hit_rate"), nw.get("cache_hit_rate")
+        if isinstance(b, (int, float)) and isinstance(v, (int, float)) \
+                and b > 0 and v < b - threshold:
+            regs.append({"query": f"serving.{mode}",
+                         "field": "cache_hit_rate", "old": b, "new": v})
     return regs
 
 

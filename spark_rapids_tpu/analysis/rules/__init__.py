@@ -6,6 +6,7 @@ in ``docs/static_analysis.md``; each module groups the rules of one
 invariant family.
 """
 from . import (  # noqa: F401  (imported for registration side effect)
+    cache_rules,
     cancellation,
     compile_path,
     drift,

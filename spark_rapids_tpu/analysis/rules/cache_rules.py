@@ -1,0 +1,115 @@
+"""Cache rule: the serving-cache validation + observability contract.
+
+The serving result cache (serving/) replays PERSISTED answers, so its
+two standing promises are structural enough to lint:
+
+* **Validate before trusting** — every read site that loads cached
+  frames must first parse the manifest and run the fingerprint
+  validation ladder (plan fingerprint, query fingerprint, schema,
+  conf snapshot, data material) in the same function; deserializing
+  frame bytes that never went through ``load_frames``'s eager CRC pass
+  is forbidden outright.
+* **Decisions are observable** — every invalidation / eviction /
+  quarantine decision site must reach ``emit_event`` (transitively
+  within its module), and the six ``cache_*`` catalog events must all
+  be emitted from serving/, which owns them exclusively: serving/
+  emits nothing outside the ``cache_`` namespace.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Set
+
+from ..engine import AnalysisContext, Rule
+from ..findings import Finding
+from . import common
+from .drift import _emit_sites, _reaches_emit
+
+#: the serving-cache event namespace — one entry per EVENT_CATALOG
+#: cache_* registration (telemetry/events.py)
+CACHE_EVENTS: Set[str] = {
+    "cache_hit", "cache_miss", "cache_store", "cache_invalidate",
+    "cache_evict", "cache_quarantine",
+}
+
+_DECISION_RE = re.compile(r"invalidate|evict|quarantine")
+
+
+class CacheInvalidateRule(Rule):
+    id = "cache-invalidate"
+    title = ("serving-cache reads validate fingerprints; "
+             "invalidation decisions emit cache_* events")
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        rels = common.scoped(ctx, prefixes=("serving/",))
+        mods = list(ctx.resolver.modules(rels))
+        if not mods:
+            return [self.finding(
+                "health", common.PKG + "serving", 0,
+                "serving/ package missing or unparseable")]
+        read_sites = 0
+        decision_sites = 0
+        for mi in mods:
+            for fi in mi.functions:
+                calls = fi.own_call_names
+                if "load_frames" in calls:
+                    read_sites += 1
+                    if "read_manifest" not in calls:
+                        out.append(self.finding(
+                            "cache-read", mi.rel, fi.lineno,
+                            f"{fi.qualname}() loads cached frames "
+                            f"without parsing the manifest first — "
+                            f"the commit marker and frame records "
+                            f"live there",
+                            detail=f"{fi.qualname}:no-manifest"))
+                    if not any(n.startswith("_validate")
+                               or n == "plan_fingerprints"
+                               for n in calls):
+                        out.append(self.finding(
+                            "cache-read", mi.rel, fi.lineno,
+                            f"{fi.qualname}() loads cached frames "
+                            f"without validating the plan/query/data "
+                            f"fingerprints — a cached result may only "
+                            f"be trusted after the full ladder",
+                            detail=f"{fi.qualname}:no-validation"))
+                elif "deserialize" in calls:
+                    out.append(self.finding(
+                        "cache-read", mi.rel, fi.lineno,
+                        f"{fi.qualname}() deserializes frame bytes "
+                        f"that never went through load_frames's eager "
+                        f"CRC verification",
+                        detail=f"{fi.qualname}:no-crc"))
+                if _DECISION_RE.search(fi.name):
+                    decision_sites += 1
+                    if not _reaches_emit(fi, mi):
+                        out.append(self.finding(
+                            "cache-decision", mi.rel, fi.lineno,
+                            f"{fi.qualname}() makes an invalidation/"
+                            f"eviction/quarantine decision but never "
+                            f"reaches emit_event (within {mi.rel}) — "
+                            f"cache decisions must be observable",
+                            detail=f"{fi.qualname}:cache-decision"))
+        emitted = {lit for _fi, _c, lit in _emit_sites(ctx, rels)
+                   if lit}
+        for name in sorted(CACHE_EVENTS - emitted):
+            out.append(self.finding(
+                "cache-required", common.PKG + "serving", 0,
+                f"serving/ must emit {name!r} (the cache audit trail "
+                f"the serving docs promise)",
+                detail=f"required:{name}"))
+        for name in sorted(emitted):
+            if not name.startswith("cache_"):
+                out.append(self.finding(
+                    "namespace", common.PKG + "serving", 0,
+                    f"serving/ emits {name!r} — serving events live "
+                    f"in the cache_ namespace",
+                    detail=f"namespace:{name}"))
+        out.extend(self.health(
+            read_sites >= 1, common.PKG + "serving",
+            f"expected >=1 cached-frame read site, saw {read_sites}"))
+        out.extend(self.health(
+            decision_sites >= 3, common.PKG + "serving",
+            f"expected >=3 invalidate/evict/quarantine decision "
+            f"functions, saw {decision_sites}"))
+        return out

@@ -136,7 +136,7 @@ def dump_markdown() -> str:
               "", _SCHEDULING_DOC, "", _QOS_DOC, "",
               _OBSERVABILITY_DOC, "", _PERF_TUNING_DOC, "",
               _SHUFFLE_DOC, "", _ADAPTIVE_DOC, "", _RECOVERY_DOC, "",
-              _STREAMING_DOC]
+              _STREAMING_DOC, "", _SERVING_CACHE_DOC]
     return "\n".join(lines)
 
 
@@ -239,6 +239,47 @@ continuous queries (`spark_rapids_tpu/streaming/`, docs/streaming.md):
 * Every decision emits a `stream_*` telemetry event; results are
   bit-identical to a cold recompute of the same cumulative input,
   including under fault injection and ladder degradation."""
+
+
+_SERVING_CACHE_DOC = """\
+## Sub-second serving: prepared statements & the serving caches
+
+The `serving.cache.*` confs (table above) configure the serving
+subsystem (`spark_rapids_tpu/serving/`, docs/serving_cache.md):
+
+* **Prepared statements** — `Session.prepare(plan)` extracts literal
+  parameters from the logical plan into a parameterized skeleton;
+  `prepared.execute(params)` / `prepared.submit(params)` re-bind
+  literals at dispatch without re-planning, re-fingerprinting or
+  re-fusing the plan.
+* **Plan-template cache** — keyed by the skeleton fingerprint (the
+  KernelCache fingerprint discipline applied to optimized-plan
+  skeletons): ad-hoc `submit()` calls that normalize to an
+  already-seen template reuse the cached optimized physical plan and
+  fused segments instead of planning from scratch
+  (`serving.cache.templates.maxEntries` bounds the LRU).
+* **Result cache** — keyed by the recovery subsystem's rung-invariant
+  query+data fingerprint (plan fingerprint x per-file leaf material
+  from the discovery stat pass) and stored in the CheckpointStore
+  frame format under the reserved `serving/` directory of the
+  recovery root.  A `submit()` whose fingerprint matches a cached
+  result completes BEFORE admission — a hit never queues, never
+  holds an HBM reservation and reports `exec_path == "cache"`.
+* **Invalidation, never a stale answer** — every read re-stats the
+  scanned files (the same per-file fingerprints the streaming ledger
+  commits) and re-validates plan fingerprint, schema signature,
+  result-affecting conf snapshot and frame CRCs; ANY doubt
+  quarantines the entry (`cache_quarantine`) and the query executes
+  cold.  Changed inputs invalidate eagerly (`cache_invalidate`).
+* **Eviction** — `serving.cache.results.maxBytes` caps the on-disk
+  result bytes; least-recently-used entries are evicted
+  (`cache_evict`).  `cache_hit`/`cache_miss`/`cache_store` events and
+  `serving.cache.*` metrics (plus the per-tenant `cacheHits` counter)
+  make every decision observable.
+* **Streaming composition** — a maintained incremental streaming
+  aggregate registers its materialized per-tick result in the result
+  cache, so a `submit()` of the stream's own query between ticks is a
+  cache hit instead of a recompute."""
 
 
 _ADAPTIVE_DOC = """\
@@ -766,6 +807,45 @@ STREAMING_STATE_DIR = conf("spark.rapids.tpu.streaming.stateDir").doc(
     "checkpoints it references, which is what crash recovery wants, "
     "in a subtree hygiene sweeps never touch)"
 ).string_conf("")
+
+# --- serving caches (serving/; reference: parameterized prepared
+# statements + plan-template caching per "Accelerating Presto with
+# GPUs" — one compile serves millions of distinct literals) ---------------
+SERVING_CACHE_ENABLED = conf("spark.rapids.tpu.serving.cache.enabled").doc(
+    "Master enable for the serving caches: Session.submit() consults "
+    "the plan-template cache (skip planning/fusion for plans that "
+    "normalize to a seen skeleton) and the fingerprint-keyed result "
+    "cache (a validated hit completes before admission and never "
+    "queues).  Session.prepare() works regardless; this gates the "
+    "caching of ad-hoc submissions").boolean_conf(False)
+SERVING_CACHE_TEMPLATE_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.serving.cache.templates.maxEntries").doc(
+    "LRU capacity of the in-memory plan-template cache (entries hold "
+    "one optimized+fused physical plan per (skeleton fingerprint, "
+    "literal binding); eviction drops the planned tree, not any "
+    "compiled kernel — those live in the kernel cache)").int_conf(128)
+SERVING_CACHE_RESULTS_ENABLED = conf(
+    "spark.rapids.tpu.serving.cache.results.enabled").doc(
+    "Result-cache tier of the serving subsystem: completed query "
+    "results persist as CRC32C-stamped frames keyed by the recovery "
+    "query+data fingerprint, and a later submit of the same query "
+    "over unchanged inputs is served from the cache without "
+    "executing (requires serving.cache.enabled)").boolean_conf(True)
+SERVING_CACHE_RESULTS_MAX_BYTES = conf(
+    "spark.rapids.tpu.serving.cache.results.maxBytes").doc(
+    "Byte budget of the on-disk result cache: storing a new result "
+    "evicts least-recently-used entries until the total fits (0 "
+    "disables the cap)").long_conf(1024 * 1024 * 1024)
+SERVING_CACHE_RESULTS_MAX_ENTRY_BYTES = conf(
+    "spark.rapids.tpu.serving.cache.results.maxEntryBytes").doc(
+    "Largest single result the cache will store; bigger results "
+    "execute normally and are simply not cached (0 disables the "
+    "per-entry cap)").long_conf(256 * 1024 * 1024)
+SERVING_CACHE_DIR = conf("spark.rapids.tpu.serving.cache.dir").doc(
+    "Directory holding cached result frames; empty uses the reserved "
+    "serving/ directory under the recovery root, which the recovery "
+    "hygiene sweep skips by name (the serving cache runs its own "
+    "byte-budget eviction)").string_conf("")
 
 # --- concurrent query scheduler (scheduler/; reference: Theseus-style
 # admission + memory arbitration across concurrent queries) ----------------

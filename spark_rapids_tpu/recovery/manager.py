@@ -118,6 +118,46 @@ def _leaf_material(node, out: List[str]) -> None:
         _leaf_material(c, out)
 
 
+def plan_fingerprints(conf, plan) -> Tuple[Optional[object], Optional[str],
+                                           Optional[str], List[str]]:
+    """THE query-fingerprint computation, shared by every consumer —
+    ``RecoveryManager.attach_query``, the serving plan-template cache
+    and the serving result cache (serving/) all call this one helper so
+    their fingerprints can never drift apart.
+
+    Returns ``(host_phys, plan_fp, query_fp, material)``:
+
+    * ``host_phys`` — the rung-invariant HOST physical plan
+      (``Planner(conf).plan(optimize(plan))``),
+    * ``plan_fp`` — digest of the host plan tree alone (data-independent
+      — the result-cache manifest records it separately so a stale hit
+      can name WHICH identity diverged),
+    * ``query_fp`` — digest of the plan tree plus leaf DATA identity
+      (content checksums of in-memory batches, path+size+mtime_ns of
+      scanned files from the scans.py discovery stat pass),
+    * ``material`` — the per-leaf identity strings the data half was
+      derived from (the result cache revalidates these against the
+      live sources before serving a frame).
+
+    Returns ``(None, None, None, [])`` for nondeterministic plans —
+    neither recovery nor any cache may fingerprint a plan whose two
+    executions can legitimately disagree.  Raises on planner failure;
+    callers that must never fail a query wrap it."""
+    from ..adaptive.executor import _has_nondeterministic
+    from ..plan.optimizer import optimize
+    from ..plan.planner import Planner
+
+    host_phys = Planner(conf).plan(optimize(plan))
+    if _has_nondeterministic(host_phys):
+        return None, None, None, []
+    material: List[str] = []
+    _leaf_material(host_phys, material)
+    tree = host_phys.tree_string()
+    plan_fp = _digest(tree)
+    query_fp = _digest(tree + "\n" + "\n".join(material))
+    return host_phys, plan_fp, query_fp, material
+
+
 def _exchange_key(node) -> Optional[str]:
     """The rung-invariant subtree string of an exchange node, or None
     for non-exchange nodes.  The TPU exec fingerprints via its
@@ -169,19 +209,12 @@ class RecoveryManager:
         if not (self.write_enabled or self.resume_enabled):
             return
         try:
-            from ..adaptive.executor import _has_nondeterministic
-            from ..plan.optimizer import optimize
-            from ..plan.planner import Planner
-
-            host_phys = Planner(self.conf).plan(optimize(plan))
-            if _has_nondeterministic(host_phys):
+            _, _, query_fp, _ = plan_fingerprints(self.conf, plan)
+            if query_fp is None:
                 log.debug("recovery declined: nondeterministic plan")
                 self.write_enabled = self.resume_enabled = False
                 return
-            material: List[str] = []
-            _leaf_material(host_phys, material)
-            self.query_fp = _digest(
-                host_phys.tree_string() + "\n" + "\n".join(material))
+            self.query_fp = query_fp
         except Exception:  # noqa: BLE001 - recovery must never fail a query
             log.warning("recovery disabled: query fingerprint failed",
                         exc_info=True)

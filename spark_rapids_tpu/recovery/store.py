@@ -48,6 +48,11 @@ QUARANTINE_PREFIX = "quarantine-"
 #: (streaming/ledger.py) — never a query dir, never swept as one
 STREAMS_DIRNAME = "streams"
 
+#: reserved subdirectory of the recovery root holding the serving
+#: result cache (serving/result_cache.py) — it runs its own byte-budget
+#: LRU eviction, so the recovery hygiene sweep skips it by name
+SERVING_DIRNAME = "serving"
+
 #: process-global pin registry: ``realpath(root) -> {query_fp}``.  A
 #: pinned query dir holds the live aggregate state of an active stream;
 #: TTL/maxBytes sweeps must not evict it no matter how old or large.
@@ -214,8 +219,8 @@ class CheckpointStore:
         directories (LRU by dir mtime, refreshed on every checkpoint
         write).  Quarantined exchanges expire with their query dir.
         Pinned query dirs (an active stream's aggregate state) and the
-        reserved ``streams`` ledger dir are skipped entirely.  Never
-        raises."""
+        reserved ``streams`` ledger / ``serving`` result-cache dirs are
+        skipped entirely.  Never raises."""
         removed_tmp = fsio.sweep_tmp_files(self.root)
         removed_dirs = 0
         now = time.time()
@@ -223,7 +228,8 @@ class CheckpointStore:
         try:
             entries = []
             for name in os.listdir(self.root):
-                if name == STREAMS_DIRNAME or name in protected:
+                if name in (STREAMS_DIRNAME, SERVING_DIRNAME) \
+                        or name in protected:
                     continue
                 path = os.path.join(self.root, name)
                 if not os.path.isdir(path):

@@ -140,9 +140,13 @@ class QueryHandle:
         #: last_profile are last-writer-wins under concurrency)
         self.metrics: Dict = {}
         self.profile = None
-        #: "tpu" or "cpu" — which path produced the result (the
-        #: circuit-breaker rung)
+        #: "tpu", "cpu" (the circuit-breaker rung) or "cache" (served
+        #: from the serving result cache before admission) — which path
+        #: produced the result
         self.exec_path: Optional[str] = None
+        #: serving-cache identity captured at submit time (serving/);
+        #: the worker stores the result under it at success
+        self._serving_key = None
         self._ctx = None  # the native attempt's ExecContext
 
     # ----- caller API ------------------------------------------------------
@@ -280,9 +284,31 @@ class QueryScheduler:
                deadline_ms: Optional[int] = None) -> QueryHandle:
         from ..telemetry.events import emit_event
 
+        # serving result-cache lookup BEFORE admission (serving/):
+        # fingerprinting and the validated disk read happen outside the
+        # scheduler lock, and a hit completes the handle immediately —
+        # it never queues, never occupies a slot and is never shed.
+        # Callers that bring their own RecoveryManager (streaming
+        # micro-batches) bypass the cache: their execution must write
+        # checkpoints for the next incremental tick to merge from.
+        cached = None
+        serving_key = None
+        serving = self.session.serving_if_enabled()
+        if serving is not None and recovery is None:
+            serving_key = serving.results.fingerprint(plan)
+            if serving_key is not None:
+                cached = serving.results.lookup(serving_key)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("QueryScheduler is shut down")
+            if cached is not None:
+                handle = QueryHandle(self, next(self._next_qid), plan,
+                                     priority, tenant, recovery=recovery,
+                                     deadline_ms=deadline_ms)
+                handle.exec_path = "cache"
+                self.qos.count_cache_hit_locked(tenant)
+                handle._finish(QueryStatus.FINISHED, result=cached)
+                return handle
             self._maybe_shed_overload_locked(priority, tenant)
             queued = self.qos.queued_count_locked()
             if queued >= self.max_queued \
@@ -306,6 +332,7 @@ class QueryScheduler:
             handle = QueryHandle(self, next(self._next_qid), plan,
                                  priority, tenant, recovery=recovery,
                                  deadline_ms=deadline_ms)
+            handle._serving_key = serving_key
             self.qos.enqueue_locked(handle)
             self._cv.notify_all()
         return handle
@@ -526,6 +553,7 @@ class QueryScheduler:
                     handle.plan, scheduled=True, cancel_token=token,
                     ctx_sink=sink, recovery=handle.recovery)
                 handle.exec_path = "tpu"
+                self._store_serving_result(handle, out)
                 self._attribute(handle, sink)
                 if handle.preemptions:
                     # work-preserving resume evidence: the recovery
@@ -583,6 +611,19 @@ class QueryScheduler:
                 self.qos.note_done_locked(
                     handle, _DONE_COUNTER.get(handle.status()))
                 self._cv.notify_all()
+
+    def _store_serving_result(self, handle: QueryHandle, out) -> None:
+        """Store-at-success hook of the serving result cache: the
+        fingerprint captured at submit time is re-validated against a
+        FRESH stat of the file material inside ``store_result``, so a
+        source rewritten mid-flight is never cached under the stale
+        pre-execution identity.  Never raises (the cache fails open)."""
+        key = handle._serving_key
+        if key is None:
+            return
+        serving = self.session.serving_if_enabled()
+        if serving is not None:
+            serving.results.store_result(key, out)
 
     # ----- preemption (victim side) -----------------------------------------
     def _requeue_preempted(self, handle: QueryHandle, sink: Dict,
@@ -792,6 +833,9 @@ class QueryScheduler:
         merged["fault.degradeLevel"] = DEGRADE_CPU
         handle.metrics = merged
         handle.exec_path = "cpu"
+        # the CPU rung's result is bit-identical by the oracle contract,
+        # so it is just as cacheable as the native one
+        self._store_serving_result(handle, out)
         handle._finish(QueryStatus.FINISHED, result=out)
 
     # ----- lifecycle -------------------------------------------------------

@@ -1,0 +1,51 @@
+"""Sub-second serving: prepared statements + plan-template/result caches.
+
+Three cooperating layers take repeated queries from full execution to a
+validated disk read (docs/serving_cache.md):
+
+1. **Prepared statements** (prepared.py) — ``Session.prepare(plan)``
+   extracts literal parameters into a skeleton; ``execute(params)``
+   re-binds them at dispatch without rebuilding the query.
+2. **Plan-template cache** (template.py) — skeleton-keyed LRU of fully
+   planned physical trees, consulted by ``Session.prepare_execution``
+   so even ad-hoc submissions that normalize to a seen template skip
+   planning and fusion.
+3. **Result cache** (result_cache.py) — completed results persist as
+   CRC32C-stamped frames keyed by the recovery query+data fingerprint;
+   the scheduler serves a validated hit BEFORE admission (a hit never
+   queues and is never shed), and the streaming ledger pushes
+   invalidation when source files change.
+
+Everything is gated by ``serving.cache.*`` confs and fails OPEN: any
+serving-layer error steps aside and the query executes normally.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from .prepared import (Param, PreparedStatement, bind_parameters,
+                       binding_digest, extract_parameters,
+                       skeleton_fingerprint)
+from .result_cache import (ResultCache, ServingKey, invalidate_for_files,
+                           register_stream_result, serving_root)
+from .template import TemplateCache
+
+__all__ = [
+    "Param", "PreparedStatement", "ResultCache", "ServingCaches",
+    "ServingKey", "TemplateCache", "bind_parameters", "binding_digest",
+    "extract_parameters", "invalidate_for_files",
+    "register_stream_result", "serving_root", "skeleton_fingerprint",
+]
+
+
+class ServingCaches:
+    """The session-owned cache pair (``Session.serving``)."""
+
+    def __init__(self, session):
+        self.templates = TemplateCache(session.conf)
+        self.results = ResultCache(session.conf)
+
+    def metrics(self) -> Dict[str, int]:
+        out = dict(self.templates.metrics())
+        out.update(self.results.metrics())
+        return out

@@ -1,0 +1,237 @@
+"""Prepared statements: literal extraction, skeletons, parameter binding.
+
+``Session.prepare(plan)`` walks the logical plan and lifts every
+``Literal`` out of its expression trees into a positional :class:`Param`
+placeholder, producing a parameterized SKELETON.  The skeleton is the
+normalization the whole serving subsystem keys on:
+
+* two ad-hoc submissions that differ only in literal values normalize
+  to the SAME skeleton fingerprint (the plan-template cache reuses the
+  planned tree across them when the binding also matches),
+* ``PreparedStatement.execute(params)`` re-binds literals at dispatch
+  (a cheap tree copy) instead of re-building the query.
+
+Extraction is conservative by construction: an expression field this
+module does not know about keeps its literals INLINE — they stay part
+of the skeleton's ``tree_string`` and simply make its fingerprint more
+specific.  Failing to parameterize can only cost cache hits, never
+correctness (over-sharing would be the dangerous direction).
+
+No jax in this module: skeletons are never executed — a ``Param`` that
+reaches evaluation raises, it exists only for fingerprinting.
+"""
+from __future__ import annotations
+
+import copy
+import datetime
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..ops.expression import Expression, Literal
+from ..plan import functions as F
+from ..plan import logical as L
+from ..recovery.manager import RESULT_CONF_KEYS, _digest
+
+#: logical node type -> the attribute names holding expression trees
+#: (or lists / lists-of-lists thereof) that extraction rewrites; node
+#: types absent here keep their literals inline (safe: more-specific
+#: skeleton, never a wrong share)
+_EXPR_FIELDS = {
+    L.Project: ("exprs",),
+    L.Filter: ("condition",),
+    L.Aggregate: ("keys", "aggregates"),
+    L.Join: ("left_keys", "right_keys", "condition"),
+    L.Sort: ("keys",),
+    L.Repartition: ("keys",),
+    L.Expand: ("projections",),
+    L.Generate: ("elements",),
+    L.Window: ("window_exprs",),
+}
+
+
+class Param(Expression):
+    """Positional placeholder for an extracted literal.  Exists only in
+    skeletons — evaluating one means a plan was executed without
+    :func:`bind_parameters`, which is a caller bug, not a fallback."""
+
+    def __init__(self, index: int, dtype: T.DType):
+        super().__init__()
+        self.index = index
+        self._dtype = dtype
+
+    @property
+    def dtype(self) -> T.DType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def sql(self) -> str:
+        return f"$p{self.index}"
+
+    def eval_cpu(self, batch):
+        raise RuntimeError(
+            f"unbound prepared-statement parameter $p{self.index}")
+
+
+def _map_field(value, expr_fn):
+    """Apply ``expr_fn`` (an ``Expression -> Expression`` rewrite)
+    through the container shapes expression fields come in: a bare
+    expression, a list (Project), a list of lists (Expand), a SortKey
+    wrapper (Sort).  Anything else passes through untouched."""
+    if isinstance(value, Expression):
+        return expr_fn(value)
+    if isinstance(value, F.SortKey):
+        return F.SortKey(expr_fn(value.expr), value.ascending,
+                         value.nulls_first)
+    if isinstance(value, list):
+        return [_map_field(v, expr_fn) for v in value]
+    return value
+
+
+def _rewrite_plan(node, expr_fn):
+    """Structural copy of the logical tree with every known expression
+    field rewritten (the ``copy.copy + children`` idiom — logical nodes
+    are plain attribute bags)."""
+    clone = copy.copy(node)
+    clone.children = [_rewrite_plan(c, expr_fn) for c in node.children]
+    for field in _EXPR_FIELDS.get(type(node), ()):
+        value = getattr(node, field, None)
+        if value is not None:
+            setattr(clone, field, _map_field(value, expr_fn))
+    return clone
+
+
+def extract_parameters(plan) -> Tuple[Any, List[Tuple[Any, T.DType]]]:
+    """Lift every ``Literal`` in ``plan``'s expression trees into a
+    positional :class:`Param`; returns ``(skeleton, params)`` where
+    ``params[i]`` is the ``(value, dtype)`` the submission carried at
+    position ``i`` (the defaults of a prepared statement, and the
+    binding of an ad-hoc template-cache probe).  Deterministic order:
+    preorder over the plan, bottom-up over each expression tree."""
+    params: List[Tuple[Any, T.DType]] = []
+
+    def replace(e):
+        # exactly Literal: a subclass may carry semantics beyond its
+        # value, and extraction must never change behavior
+        if type(e) is Literal:
+            p = Param(len(params), e.dtype)
+            params.append((e.value, e.dtype))
+            return p
+        return None
+
+    skeleton = _rewrite_plan(plan, lambda expr: expr.transform(replace))
+    return skeleton, params
+
+
+def _check_bindable(value, dtype: T.DType, index: int) -> None:
+    if value is None:
+        return
+    if dtype.id is T.TypeId.DATE32 and isinstance(
+            value, (int, datetime.date)):
+        return
+    try:
+        from ..ops.expression import _infer_literal_type
+
+        inferred = _infer_literal_type(value)
+    except TypeError as e:
+        raise ValueError(f"parameter $p{index}: {e}") from None
+    numeric = (T.TypeId.INT32, T.TypeId.INT64, T.TypeId.FLOAT64)
+    if inferred.id is dtype.id:
+        return
+    if inferred.id in numeric and dtype.id in numeric:
+        return
+    raise ValueError(
+        f"parameter $p{index} expects {dtype}, got "
+        f"{type(value).__name__} ({value!r})")
+
+
+def bind_parameters(skeleton, values: Sequence[Any]):
+    """Inverse of :func:`extract_parameters`: substitute ``values[i]``
+    for ``$p{i}``, keeping each parameter's extracted dtype (so the
+    bound plan's schema — and with it every kernel shape — is stable
+    across bindings).  Raises ``ValueError`` on arity or obvious type
+    mismatch; a missing binding is an error, never a silent null."""
+    values = list(values)
+    seen: set = set()
+
+    def replace(e):
+        if isinstance(e, Param):
+            if e.index >= len(values):
+                raise ValueError(
+                    f"parameter $p{e.index} has no binding "
+                    f"({len(values)} values given)")
+            _check_bindable(values[e.index], e.dtype, e.index)
+            seen.add(e.index)
+            return Literal(values[e.index], e.dtype)
+        return None
+
+    bound = _rewrite_plan(skeleton, lambda expr: expr.transform(replace))
+    if len(values) > len(seen):
+        raise ValueError(
+            f"{len(values)} values bound but skeleton has "
+            f"{len(seen)} parameters")
+    return bound
+
+
+def skeleton_fingerprint(conf, skeleton) -> str:
+    """Digest of the skeleton's logical tree plus the result-affecting
+    conf snapshot (``RESULT_CONF_KEYS`` — the recovery discipline): two
+    sessions differing on a result-affecting conf must never share a
+    template."""
+    snap = "\n".join(
+        f"{k}={conf.get_key(k)!r}" for k in RESULT_CONF_KEYS)
+    return _digest(skeleton.tree_string() + "\n" + snap)
+
+
+def binding_digest(values: Sequence[Any]) -> str:
+    """Digest of one literal binding (positional ``repr`` — exact, not
+    canonicalized: ``1`` and ``1.0`` are different bindings because
+    they plan to different literal dtypes)."""
+    return _digest(repr([(i, type(v).__name__, repr(v))
+                         for i, v in enumerate(values)]))
+
+
+class PreparedStatement:
+    """Handle returned by ``Session.prepare(plan)``.
+
+    ``execute(params)`` / ``submit(params)`` re-bind the extracted
+    literals and dispatch — planning/fusion is skipped whenever the
+    (skeleton, binding) pair is in the plan-template cache, and a
+    ``submit`` additionally consults the result cache before admission
+    (``serving.cache.enabled``)."""
+
+    def __init__(self, session, plan):
+        self.session = session
+        self.skeleton, params = extract_parameters(plan)
+        #: the literal values the prepared plan carried, in parameter
+        #: order — ``execute()`` with no arguments replays them
+        self.defaults: Tuple[Any, ...] = tuple(v for v, _ in params)
+        self.dtypes: Tuple[T.DType, ...] = tuple(d for _, d in params)
+        self.skeleton_fp = skeleton_fingerprint(session.conf,
+                                                self.skeleton)
+
+    @property
+    def num_params(self) -> int:
+        return len(self.dtypes)
+
+    def bind(self, params: Optional[Sequence[Any]] = None):
+        """The bound logical plan for ``params`` (defaults when None)."""
+        values = self.defaults if params is None else params
+        return bind_parameters(self.skeleton, values)
+
+    def execute(self, params: Optional[Sequence[Any]] = None):
+        """Execute synchronously (degradation ladder included) with the
+        given binding; returns the result ``HostBatch``."""
+        return self.session.execute(self.bind(params))
+
+    def submit(self, params: Optional[Sequence[Any]] = None, *,
+               priority: int = 0, tenant: str = "default"):
+        """Submit through the concurrent scheduler (result-cache lookup
+        before admission); returns a ``QueryHandle``."""
+        return self.session.submit(self.bind(params),
+                                   priority=priority, tenant=tenant)
+
+    def explain(self) -> str:
+        return self.skeleton.tree_string()

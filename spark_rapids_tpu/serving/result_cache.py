@@ -1,0 +1,426 @@
+"""Fingerprint-keyed result cache: serve repeated queries from disk.
+
+Results persist as CRC32C-stamped serialized HostBatch frames under
+the reserved ``serving/`` directory of the recovery root (or
+``serving.cache.dir``), laid out by the recovery fingerprint pair::
+
+    <root>/<plan_fp>/<query_fp>/p0-b0.srtb + manifest.json
+
+``plan_fp`` digests the rung-invariant HOST physical plan alone;
+``query_fp`` additionally folds in leaf DATA identity (content
+checksums of in-memory batches, path+size+mtime_ns of scanned files) —
+both from :func:`recovery.manager.plan_fingerprints`, THE shared
+fingerprint helper, so serving and recovery can never drift apart.
+
+The two-level layout is the invalidation mechanism: a lookup
+recomputes the fingerprints from a FRESH discovery stat pass, so when
+an input file changed the new ``query_fp`` differs, the entry under
+the OLD ``query_fp`` can never be reached again, and every such
+sibling is removed on sight (``cache_invalidate``).  The streaming
+ledger additionally pushes invalidation eagerly at commit time
+(:func:`invalidate_for_files`).
+
+Validation is the recovery resume ladder, applied paranoidly: manifest
+shape, plan fingerprint, query fingerprint, schema signature,
+result-affecting conf snapshot, per-leaf data material, per-frame
+CRC32C — a frame failing ANY check is quarantined aside
+(``cache_quarantine``) and the query executes normally.  A cache hit
+is bit-identical to a cold recompute or it is not a hit.
+
+No jax in this module: pure filesystem + numpy policy, readable from a
+process that never touches an accelerator.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+from typing import Dict, Iterable, List, Optional
+
+from ..config import (SERVING_CACHE_DIR, SERVING_CACHE_ENABLED,
+                      SERVING_CACHE_RESULTS_ENABLED,
+                      SERVING_CACHE_RESULTS_MAX_BYTES,
+                      SERVING_CACHE_RESULTS_MAX_ENTRY_BYTES)
+from ..recovery.manager import (RESULT_CONF_KEYS, plan_fingerprints,
+                                resolve_root, schema_signature)
+from ..recovery.store import (CheckpointStore, QUARANTINE_PREFIX,
+                              SERVING_DIRNAME)
+from ..telemetry.events import emit_event
+
+log = logging.getLogger(__name__)
+
+
+def serving_root(conf) -> str:
+    d = conf.get(SERVING_CACHE_DIR)
+    if d:
+        return d
+    return os.path.join(resolve_root(conf), SERVING_DIRNAME)
+
+
+class ServingKey:
+    """One submission's cache identity: the rung-invariant host plan
+    and its fingerprints, captured by ONE planning pass at lookup time
+    and reused verbatim at store time (the store path re-stats the file
+    material instead of trusting this snapshot)."""
+
+    __slots__ = ("host_phys", "plan_fp", "query_fp", "material")
+
+    def __init__(self, host_phys, plan_fp: str, query_fp: str,
+                 material: List[str]):
+        self.host_phys = host_phys
+        self.plan_fp = plan_fp
+        self.query_fp = query_fp
+        self.material = list(material)
+
+
+def _material_path(entry: str) -> Optional[str]:
+    """The file path inside one ``file:...`` material entry (None for
+    batch checksums and unparseable records)."""
+    if not entry.startswith("file:"):
+        return None
+    body = entry[5:]
+    if body.endswith(":?"):
+        return body[:-2]
+    return body.rsplit(":", 2)[0]
+
+
+class ResultCache:
+    """Disk-backed result cache over the recovery frame format."""
+
+    def __init__(self, conf):
+        self.conf = conf
+        self.enabled = bool(conf.get(SERVING_CACHE_ENABLED)) and \
+            bool(conf.get(SERVING_CACHE_RESULTS_ENABLED))
+        self.root = serving_root(conf)
+        self.store = CheckpointStore(self.root)
+        self.max_bytes = int(conf.get(SERVING_CACHE_RESULTS_MAX_BYTES)
+                             or 0)
+        self.max_entry_bytes = int(
+            conf.get(SERVING_CACHE_RESULTS_MAX_ENTRY_BYTES) or 0)
+        self._conf_snapshot = {
+            k: repr(conf.get_key(k)) for k in RESULT_CONF_KEYS}
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "hits": 0, "misses": 0, "stores": 0, "storeSkipped": 0,
+            "invalidated": 0, "evicted": 0, "quarantined": 0,
+            "bytesWritten": 0}
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    # ----- fingerprinting ---------------------------------------------------
+    def fingerprint(self, plan) -> Optional[ServingKey]:
+        """Plan + fingerprint one submission (the ONLY planning a cache
+        hit pays).  None — and the serving layer steps aside — for
+        nondeterministic plans (two executions may legitimately
+        disagree; caching one would freeze a coin flip) and for plans
+        the fingerprint helper cannot handle."""
+        if not self.enabled:
+            return None
+        try:
+            host_phys, plan_fp, query_fp, material = plan_fingerprints(
+                self.conf, plan)
+        except Exception:  # noqa: BLE001 - caching must never fail a query
+            log.debug("serving fingerprint failed", exc_info=True)
+            return None
+        if query_fp is None:
+            return None
+        return ServingKey(host_phys, plan_fp, query_fp, material)
+
+    # ----- lookup -----------------------------------------------------------
+    def lookup(self, key: Optional[ServingKey]):
+        """The cached result ``HostBatch`` for ``key``, or None.  The
+        full validation ladder runs on every hit; ANY doubt quarantines
+        the entry and reports a miss — at worst the cache buys
+        nothing."""
+        if not self.enabled or key is None:
+            return None
+        # the fingerprint was computed from a fresh stat pass: siblings
+        # under the same plan over a DIFFERENT data identity are stale
+        # (their inputs changed) and can never validate again
+        self._invalidate_siblings(key.plan_fp, key.query_fp)
+        if not self.store.has_manifest(key.plan_fp, key.query_fp):
+            self._count("misses")
+            emit_event("cache_miss", tier="result",
+                       plan_fp=key.plan_fp, query_fp=key.query_fp)
+            return None
+        d = self.store.exchange_dir(key.plan_fp, key.query_fp)
+        try:
+            manifest = self.store.read_manifest(d)
+            self._validate(manifest, key)
+            frames = self.store.load_frames(d, manifest, 1)
+            if len(frames[0]) != 1:
+                raise ValueError(
+                    f"result entry holds {len(frames[0])} frames, "
+                    "expected exactly 1")
+            from ..native.serializer import deserialize
+
+            batch = deserialize(frames[0][0], key.host_phys.schema)
+        except Exception as e:  # noqa: BLE001 - quarantine on ANY doubt
+            self._quarantine(d, key, e)
+            self._count("misses")
+            emit_event("cache_miss", tier="result",
+                       plan_fp=key.plan_fp, query_fp=key.query_fp)
+            return None
+        try:  # LRU recency for the byte-budget eviction
+            os.utime(self.store.query_dir(key.plan_fp), None)
+        except OSError:
+            pass
+        self._count("hits")
+        emit_event("cache_hit", tier="result", plan_fp=key.plan_fp,
+                   query_fp=key.query_fp, rows=int(batch.num_rows))
+        return batch
+
+    def _validate(self, manifest: Dict, key: ServingKey) -> None:
+        """The resume validation ladder on a result manifest; raises on
+        the FIRST mismatch, naming which identity diverged."""
+        if manifest.get("plan_fingerprint") != key.plan_fp:
+            raise ValueError("plan fingerprint mismatch")
+        if manifest.get("query_fingerprint") != key.query_fp:
+            raise ValueError("query fingerprint mismatch")
+        if manifest.get("schema") != \
+                schema_signature(key.host_phys.schema):
+            raise ValueError("schema signature mismatch")
+        if manifest.get("conf") != self._conf_snapshot:
+            raise ValueError("result-affecting conf snapshot mismatch")
+        if manifest.get("material") != list(key.material):
+            raise ValueError("leaf data identity mismatch")
+
+    def _quarantine(self, dirpath: str, key: ServingKey,
+                    cause: Exception) -> None:
+        self.store.quarantine(dirpath)
+        self._count("quarantined")
+        emit_event("cache_quarantine", tier="result",
+                   plan_fp=key.plan_fp, query_fp=key.query_fp,
+                   cause=type(cause).__name__, detail=str(cause))
+
+    # ----- store ------------------------------------------------------------
+    def store_result(self, key: Optional[ServingKey], batch) -> bool:
+        """Persist one completed result.  Skips (never raises) when the
+        entry exists, the frame is over ``maxEntryBytes``, the schema
+        cannot round-trip, or the file material no longer matches a
+        fresh stat — a source rewritten DURING execution must not be
+        cached under the pre-execution fingerprint."""
+        if not self.enabled or key is None or batch is None:
+            return False
+        try:
+            if not len(key.host_phys.schema) or \
+                    schema_signature(batch.schema) != \
+                    schema_signature(key.host_phys.schema):
+                self._count("storeSkipped")
+                return False
+            if self.store.has_manifest(key.plan_fp, key.query_fp):
+                return False
+            if not self._material_unchanged(key):
+                self._count("storeSkipped")
+                return False
+            from ..native.serializer import serialize
+
+            frame = serialize(batch)
+            if 0 < self.max_entry_bytes < frame.nbytes:
+                self._count("storeSkipped")
+                return False
+            manifest = {
+                "plan_fingerprint": key.plan_fp,
+                "query_fingerprint": key.query_fp,
+                "schema": schema_signature(batch.schema),
+                "conf": dict(self._conf_snapshot),
+                "material": list(key.material),
+                "rows": int(batch.num_rows),
+            }
+            self._invalidate_siblings(key.plan_fp, key.query_fp)
+            nbytes = self.store.write_exchange(
+                key.plan_fp, key.query_fp, manifest,
+                [[(frame, int(batch.num_rows))]])
+            self._count("stores")
+            self._count("bytesWritten", nbytes)
+            emit_event("cache_store", tier="result",
+                       plan_fp=key.plan_fp, query_fp=key.query_fp,
+                       nbytes=int(nbytes), rows=int(batch.num_rows))
+            self._evict_over_budget(protect=key.plan_fp)
+            return True
+        except Exception:  # noqa: BLE001 - caching must never fail a query
+            log.warning("result-cache store failed", exc_info=True)
+            return False
+
+    def _material_unchanged(self, key: ServingKey) -> bool:
+        """Re-stat every ``file:`` material entry against the live
+        filesystem; an unknown identity (``:?``) is treated as changed
+        — quarantine-on-any-doubt applies to writes too."""
+        for entry in key.material:
+            path = _material_path(entry)
+            if path is None:
+                continue  # batch: content checksums cannot go stale
+            if entry.endswith(":?"):
+                return False
+            try:
+                st = os.stat(path)
+            except OSError:
+                return False
+            if entry != f"file:{path}:{st.st_size}:{st.st_mtime_ns}":
+                return False
+        return True
+
+    # ----- invalidation / eviction -----------------------------------------
+    def _invalidate_siblings(self, plan_fp: str,
+                             keep_query_fp: str) -> int:
+        """Drop every entry of ``plan_fp`` whose data identity differs
+        from the live one — the fresh stat pass proved their inputs
+        changed, so they are unreachable forever (never served, but
+        removing them eagerly frees budget and keeps the LRU honest)."""
+        qdir = self.store.query_dir(plan_fp)
+        removed = 0
+        try:
+            names = os.listdir(qdir)
+        except OSError:
+            return 0
+        for name in names:
+            if name == keep_query_fp or \
+                    name.startswith(QUARANTINE_PREFIX):
+                continue
+            path = os.path.join(qdir, name)
+            if not os.path.isdir(path):
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            removed += 1
+            emit_event("cache_invalidate", tier="result",
+                       plan_fp=plan_fp, query_fp=name,
+                       reason="data_identity_changed")
+        if removed:
+            self._count("invalidated", removed)
+        return removed
+
+    def invalidate_paths(self, paths: Iterable[str]) -> int:
+        """Drop every cached result whose material references one of
+        ``paths`` — the eager push half of invalidation, driven by the
+        streaming ledger at commit time (the lookup-side stat pass
+        remains the backstop for non-streaming writers)."""
+        targets = set()
+        for p in paths:
+            targets.add(p)
+            targets.add(os.path.abspath(p))
+        removed = 0
+        try:
+            plan_dirs = os.listdir(self.root)
+        except OSError:
+            return 0
+        for plan_fp in plan_dirs:
+            pdir = os.path.join(self.root, plan_fp)
+            if not os.path.isdir(pdir):
+                continue
+            for query_fp in os.listdir(pdir):
+                edir = os.path.join(pdir, query_fp)
+                if not os.path.isdir(edir) or \
+                        query_fp.startswith(QUARANTINE_PREFIX):
+                    continue
+                try:
+                    manifest = self.store.read_manifest(edir)
+                except Exception:  # noqa: BLE001 - uncommitted leftovers
+                    continue
+                stale = False
+                for entry in manifest.get("material") or []:
+                    path = _material_path(entry)
+                    if path is not None and (
+                            path in targets
+                            or os.path.abspath(path) in targets):
+                        stale = True
+                        break
+                if stale:
+                    shutil.rmtree(edir, ignore_errors=True)
+                    removed += 1
+                    emit_event("cache_invalidate", tier="result",
+                               plan_fp=plan_fp, query_fp=query_fp,
+                               reason="source_changed")
+        if removed:
+            self._count("invalidated", removed)
+        return removed
+
+    def _evict_over_budget(self, protect: Optional[str] = None) -> int:
+        """LRU eviction to ``maxBytes``: oldest plan directories (dir
+        mtime, refreshed on every store AND hit) go first; the plan dir
+        just written is protected so a store can never evict itself."""
+        if self.max_bytes <= 0:
+            return 0
+        removed = 0
+        try:
+            entries = []
+            for name in os.listdir(self.root):
+                if name == protect:
+                    continue
+                path = os.path.join(self.root, name)
+                if not os.path.isdir(path):
+                    continue
+                try:
+                    entries.append((os.path.getmtime(path), name, path))
+                except OSError:
+                    continue
+            entries.sort()  # oldest first
+            over = self.store.total_bytes() - self.max_bytes
+            for _mtime, name, path in entries:
+                if over <= 0:
+                    break
+                size = sum(
+                    os.path.getsize(os.path.join(r, f))
+                    for r, _d, fs in os.walk(path) for f in fs)
+                shutil.rmtree(path, ignore_errors=True)
+                removed += 1
+                over -= size
+                emit_event("cache_evict", tier="result", plan_fp=name,
+                           nbytes=int(size), reason="maxBytes")
+        except OSError:
+            pass
+        if removed:
+            self._count("evicted", removed)
+        return removed
+
+    # ----- surface ----------------------------------------------------------
+    def total_bytes(self) -> int:
+        return self.store.total_bytes()
+
+    def metrics(self) -> Dict[str, int]:
+        with self._lock:
+            return {f"serving.result.{k}": v
+                    for k, v in self.counters.items()}
+
+
+# --------------------------------------------------------------------------
+# Entry points for the other subsystems (they own NO cache policy)
+# --------------------------------------------------------------------------
+def invalidate_for_files(conf, paths: Iterable[str]) -> int:
+    """Streaming-ledger entry point (ledger.commit): a committed batch
+    changed ``paths``, so every cached result derived from them is now
+    stale — drop them before anyone can even attempt a lookup.  Never
+    raises; returns the number of entries removed."""
+    try:
+        if not (bool(conf.get(SERVING_CACHE_ENABLED))
+                and bool(conf.get(SERVING_CACHE_RESULTS_ENABLED))):
+            return 0
+        cache = ResultCache(conf)
+        if not os.path.isdir(cache.root):
+            return 0
+        return cache.invalidate_paths(paths)
+    except Exception:  # noqa: BLE001 - ledger commit must not fail
+        log.warning("serving invalidation failed", exc_info=True)
+        return 0
+
+
+def register_stream_result(session, plan, batch) -> bool:
+    """Streaming-tick entry point (stream._tick_locked, after the
+    ledger commit): materialize the tick's cumulative result so a
+    ``submit()`` of the same query between ticks is a cache hit.  The
+    plan must be the source-pinned cumulative plan (concrete file
+    lists) — exactly what an ad-hoc submission over the same inputs
+    fingerprints to.  Never raises."""
+    try:
+        serving = session.serving_if_enabled()
+        if serving is None or batch is None:
+            return False
+        key = serving.results.fingerprint(plan)
+        if key is None:
+            return False
+        return serving.results.store_result(key, batch)
+    except Exception:  # noqa: BLE001 - a tick must not fail on caching
+        log.warning("stream result registration failed", exc_info=True)
+        return False

@@ -1,0 +1,148 @@
+"""Plan-template cache: skip planning/fusion for normalized repeats.
+
+``Session.prepare_execution`` consults this cache after its per-object
+``_plan_cache`` misses: the submission is normalized to a parameterized
+skeleton (serving/prepared.py) and, when the ``(skeleton fingerprint,
+literal binding, source identity)`` triple was planned before, the
+cached PHYSICAL tree — optimizer, planner, overrides, transitions and
+fused segments already applied — is reused without re-planning.  Even an ad-hoc ``submit()``
+of a query text the session never saw as a DataFrame object hits, as
+long as it normalizes to a seen template.
+
+Handout follows the session's ``_exec_lock`` discipline exactly: exec
+instances carry per-execution state, so a cached tree is given to ONE
+execution at a time (non-blocking acquire — a busy tree counts as a
+miss and the caller plans fresh rather than waiting).
+
+Entries hold planned trees only; compiled kernels live in the process
+kernel cache and survive template eviction.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from ..config import SERVING_CACHE_TEMPLATE_MAX_ENTRIES
+from ..telemetry.events import emit_event
+from .prepared import binding_digest, extract_parameters, \
+    skeleton_fingerprint
+
+#: cache key: (skeleton fingerprint, literal-binding digest, source
+#: identity digest)
+TemplateKey = Tuple[str, str, str]
+
+
+def _source_digest(plan) -> str:
+    """Digest of the plan's scan-leaf DATA identity from a fresh
+    discovery stat pass (path+size+mtime_ns per file).  A planned
+    physical tree bakes the discovered file list into its scan execs,
+    so a template planned before a source directory grew or a file was
+    rewritten describes the OLD input — folding the live identity into
+    the key makes such a template unreachable instead of stale.
+    In-memory relations are immutable and contribute nothing."""
+    from ..io.scans import discover_files
+    from ..plan import logical as L
+    from ..recovery.manager import _digest, file_material
+
+    material: list = []
+
+    def walk(node) -> None:
+        if isinstance(node, L.FileScan):
+            _files, _values, _keys, fps = discover_files(node.paths)
+            material.extend(file_material(fp) for fp in fps)
+        for c in getattr(node, "children", ()):
+            walk(c)
+
+    walk(plan)
+    return _digest("\n".join(material))
+
+
+class TemplateCache:
+    """LRU of planned physical trees keyed by normalized skeleton +
+    literal binding (``serving.cache.templates.maxEntries``)."""
+
+    def __init__(self, conf):
+        self.conf = conf
+        self.max_entries = max(
+            1, int(conf.get(SERVING_CACHE_TEMPLATE_MAX_ENTRIES) or 1))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[TemplateKey, object]" = OrderedDict()
+        self.counters: Dict[str, int] = {
+            "hits": 0, "misses": 0, "busy": 0,
+            "stores": 0, "evicted": 0}
+
+    # ----- keying -----------------------------------------------------------
+    def key_for(self, plan) -> Optional[TemplateKey]:
+        """Normalize ``plan`` to its template key, or None when the
+        plan does not normalize (an unknown node shape raising during
+        extraction) — then the serving layer simply steps aside."""
+        try:
+            skeleton, params = extract_parameters(plan)
+            skel_fp = skeleton_fingerprint(self.conf, skeleton)
+            bind_fp = binding_digest([v for v, _ in params])
+            return (skel_fp, bind_fp, _source_digest(plan))
+        except Exception:  # noqa: BLE001 - never fail the submit path
+            return None
+
+    # ----- lookup / store ---------------------------------------------------
+    def acquire(self, key: Optional[TemplateKey]):
+        """A cached physical tree for ``key`` with its ``_exec_lock``
+        HELD, or None on miss (including the busy-tree case — the
+        caller plans fresh, as ``prepare_execution`` does for its own
+        cache)."""
+        if key is None:
+            return None
+        with self._lock:
+            phys = self._entries.get(key)
+            if phys is not None:
+                self._entries.move_to_end(key)
+        if phys is None:
+            with self._lock:
+                self.counters["misses"] += 1
+            emit_event("cache_miss", tier="template",
+                       skeleton=key[0], binding=key[1])
+            return None
+        if not phys._exec_lock.acquire(blocking=False):
+            with self._lock:
+                self.counters["busy"] += 1
+                self.counters["misses"] += 1
+            emit_event("cache_miss", tier="template",
+                       skeleton=key[0], binding=key[1], reason="busy")
+            return None
+        with self._lock:
+            self.counters["hits"] += 1
+        emit_event("cache_hit", tier="template",
+                   skeleton=key[0], binding=key[1])
+        return phys
+
+    def store(self, key: Optional[TemplateKey], phys) -> None:
+        """Remember a freshly planned tree; evicts LRU entries past
+        ``maxEntries`` (dropping only the planned tree — its compiled
+        kernels stay in the kernel cache)."""
+        if key is None:
+            return
+        evicted = []
+        with self._lock:
+            self._entries[key] = phys
+            self._entries.move_to_end(key)
+            self.counters["stores"] += 1
+            while len(self._entries) > self.max_entries:
+                old_key, _ = self._entries.popitem(last=False)
+                self.counters["evicted"] += 1
+                evicted.append(old_key)
+        emit_event("cache_store", tier="template", skeleton=key[0],
+                   binding=key[1])
+        for old_key in evicted:
+            emit_event("cache_evict", tier="template",
+                       skeleton=old_key[0], reason="maxEntries")
+
+    # ----- surface ----------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def metrics(self) -> Dict[str, int]:
+        with self._lock:
+            return {f"serving.template.{k}": v
+                    for k, v in self.counters.items()}

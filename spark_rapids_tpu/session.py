@@ -88,6 +88,9 @@ class Session:
 
         self._scheduler = None
         self._scheduler_lock = _threading.Lock()
+        # serving caches (serving/) — created lazily on first prepare()
+        # or first cache-enabled submission
+        self._serving = None
         from .config import TRACE_ENABLED
         from .utils import tracing
 
@@ -225,6 +228,20 @@ class Session:
         if phys is not None and not phys._exec_lock.acquire(
                 blocking=False):
             phys = None  # cached tree busy in another thread
+        serving = self.serving_if_enabled()
+        template_key = None
+        if phys is None and serving is not None:
+            # plan-template cache: a DIFFERENT plan object that
+            # normalizes to a seen (skeleton, binding) template reuses
+            # its planned tree — acquire() hands it out with the same
+            # non-blocking _exec_lock discipline as the cache above
+            template_key = serving.templates.key_for(plan)
+            phys = serving.templates.acquire(template_key)
+            if phys is not None:
+                try:
+                    self._plan_cache[plan] = phys
+                except TypeError:
+                    pass
         if phys is None:
             phys = self.physical_plan(plan)
             phys._exec_lock = threading.Lock()
@@ -233,6 +250,8 @@ class Session:
                 self._plan_cache[plan] = phys
             except TypeError:
                 pass
+            if serving is not None and template_key is not None:
+                serving.templates.store(template_key, phys)
         if self.capture_plans:
             self._executed_plans.append(phys)
         if recovery is None:
@@ -621,6 +640,44 @@ class Session:
                 self._scheduler = QueryScheduler(self)
             return self._scheduler
 
+    # ----- sub-second serving (serving/) ------------------------------------
+    @property
+    def serving(self):
+        """The session's serving caches (prepared statements / plan
+        templates / results), created on first access."""
+        with self._scheduler_lock:
+            if self._serving is None:
+                from .serving import ServingCaches
+
+                self._serving = ServingCaches(self)
+            return self._serving
+
+    def serving_if_enabled(self):
+        """The serving caches when ``serving.cache.enabled`` is on,
+        else None — the form the hot paths (prepare_execution, the
+        scheduler's admission) consult so disabled sessions never pay
+        for normalization or fingerprinting."""
+        from .config import SERVING_CACHE_ENABLED
+
+        if not self.conf.get(SERVING_CACHE_ENABLED):
+            return None
+        return self.serving
+
+    def prepare(self, plan):
+        """Prepare ``plan`` (a DataFrame or logical plan) for repeated
+        execution: literal values are extracted into positional
+        parameters and the returned ``PreparedStatement``'s
+        ``execute(params)`` / ``submit(params)`` re-bind them at
+        dispatch — planning, fusion and compilation are reused through
+        the serving caches instead of redone (docs/serving_cache.md).
+        Works regardless of ``serving.cache.enabled`` (that conf gates
+        the caching of ad-hoc submissions)."""
+        if isinstance(plan, DataFrame):
+            plan = plan.plan
+        from .serving import PreparedStatement
+
+        return PreparedStatement(self, plan)
+
     def submit(self, plan, priority: int = 0, tenant: str = "default"):
         """Submit a query (a DataFrame or logical plan) for concurrent
         execution; returns a ``QueryHandle`` with ``result()`` /
@@ -775,8 +832,11 @@ class Session:
         merged = dict(self.last_metrics)
         with self._scheduler_lock:
             sched = self._scheduler
+            serving = self._serving
         if sched is not None:
             merged.update(sched.qos_metrics())
+        if serving is not None:
+            merged.update(serving.metrics())
         for h in self.active_streams():
             merged.update(h.progress())
         return merged

@@ -154,12 +154,10 @@ class StreamRecoveryManager(RecoveryManager):
         if not (self.write_enabled or self.resume_enabled):
             return
         try:
-            from ..adaptive.executor import _has_nondeterministic
-            from ..plan.optimizer import optimize
-            from ..plan.planner import Planner
+            from ..recovery.manager import plan_fingerprints
 
-            host_phys = Planner(self.conf).plan(optimize(plan))
-            if _has_nondeterministic(host_phys):
+            host_phys, _, query_fp, _ = plan_fingerprints(self.conf, plan)
+            if query_fp is None:
                 log.debug("stream recovery declined: nondeterministic "
                           "plan")
                 self.write_enabled = self.resume_enabled = False

@@ -71,7 +71,12 @@ def split_new_files(prev: List[Dict],
 class SourceLedger:
     """Load/commit surface of one stream's ledger document."""
 
-    def __init__(self, conf, stream_fp: str):
+    def __init__(self, conf, stream_fp: str, result_cache=None):
+        self._conf = conf  # serving-cache invalidation at commit time
+        #: the owning session's serving result cache when available, so
+        #: commit-time invalidations land in ITS counters/metrics; None
+        #: falls back to a detached policy instance
+        self._result_cache = result_cache
         self.dir = os.path.join(stream_state_root(conf), stream_fp)
         self.path = os.path.join(self.dir, LEDGER_NAME)
         self.stream_fp = stream_fp
@@ -117,6 +122,41 @@ class SourceLedger:
             "files": [list(fps) for fps in files],
             "exchanges": dict(exchanges),
         })
+        prev_files = self.files
         self.batch_id = int(batch_id)
         self.files = [list(fps) for fps in files]
         self.exchanges = dict(exchanges)
+        self._invalidate_serving(prev_files, self.files)
+
+    def _invalidate_serving(self, prev: List[List[Dict]],
+                            cur: List[List[Dict]]) -> None:
+        """Eagerly drop serving result-cache entries derived from
+        files this commit changed or extended — the push half of the
+        serving invalidation contract (serving/result_cache.py owns
+        the policy and the ``cache_invalidate`` events; this module
+        only reports WHICH paths moved).  Never fails the commit."""
+        try:
+            changed = set()
+            for i, fps in enumerate(cur):
+                old = prev[i] if i < len(prev) else []
+                stable, new_suffix = split_new_files(old, fps)
+                if not stable:
+                    # rewritten/shrunk prefix: every file of the source
+                    # is suspect, old AND new
+                    for fp in list(old) + list(fps):
+                        changed.add(fp.get("path"))
+                else:
+                    for fp in new_suffix:
+                        changed.add(fp.get("path"))
+            changed.discard(None)
+            if not changed:
+                return
+            if self._result_cache is not None:
+                self._result_cache.invalidate_paths(changed)
+            else:
+                from ..serving.result_cache import invalidate_for_files
+
+                invalidate_for_files(self._conf, changed)
+        except Exception:  # noqa: BLE001 — the commit already landed
+            log.warning("serving-cache invalidation failed",
+                        exc_info=True)

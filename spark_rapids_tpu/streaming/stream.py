@@ -41,6 +41,7 @@ from ..scheduler.cancel import CancelToken, TpuQueryCancelled, check_cancel
 from ..telemetry import spans as tspans
 from ..telemetry.events import emit_event
 from ..telemetry.spans import QueryTelemetry
+from ..serving.result_cache import register_stream_result
 from .incremental import (StreamRecoveryManager, merge_growing_exchanges,
                           stream_fingerprint)
 from .ledger import SourceLedger, split_new_files
@@ -104,7 +105,10 @@ class StreamHandle:
                     f"supported (found partition keys {keys!r})")
         self.stream_fp = stream_fingerprint(conf, plan)
         self.stream_id = f"stream-{self.stream_fp[:12]}"
-        self._ledger = SourceLedger(conf, self.stream_fp)
+        serving = session.serving_if_enabled()
+        self._ledger = SourceLedger(
+            conf, self.stream_fp,
+            result_cache=serving.results if serving is not None else None)
         #: True when a committed ledger from a previous process/handle
         #: was loaded — the next tick resumes instead of starting over
         self.resumed = self._ledger.load()
@@ -313,6 +317,11 @@ class StreamHandle:
             else max(0.0, 1.0 - resumed / stamped)
         self._ledger.commit(batch_id, admitted,
                             mgr.exchange_fps if mgr is not None else {})
+        # register the committed tick's materialized result with the
+        # serving result cache (serving/ owns policy + cache_* events):
+        # an ad-hoc submit() of the same cumulative query between ticks
+        # fingerprints to this exact (plan, data) identity and hits
+        register_stream_result(session, cum_plan, out)
         latency_ms = (time.monotonic() - t0) * 1000.0
         self.latency_hist.observe(latency_ms)
         emit_event("stream_batch_commit", stream=self.stream_id,

@@ -79,7 +79,23 @@ noteworthy engine transition emits one flat JSON record:
                        appended to its committed base checkpoint,
 ``stream_incremental_skip`` — an exchange recomputes from scratch this
                        batch; carries the reason (non-incremental plan
-                       shape, rewritten source, validation failure).
+                       shape, rewritten source, validation failure),
+``cache_hit``        — a serving-cache lookup was served from a cached
+                       template or a validated cached result; carries
+                       the tier (``template``/``result``) and key,
+``cache_miss``       — a serving-cache lookup found nothing reusable;
+                       the query plans/executes cold,
+``cache_store``      — a template or result entry was written into its
+                       serving-cache tier,
+``cache_invalidate`` — a cached result's inputs changed (re-stat or
+                       streaming-ledger fingerprint mismatch); the
+                       entry was dropped before it could serve stale,
+``cache_evict``      — the result cache's byte budget evicted a
+                       least-recently-used entry (or the template LRU
+                       dropped its oldest template),
+``cache_quarantine`` — a cached result failed validation (CRC, plan/
+                       query fingerprint, schema or conf snapshot) and
+                       was renamed aside; the query executes cold.
 
 Emission contract: call sites OUTSIDE ``telemetry/`` must only use
 :func:`emit_event`, which is exception-safe (never raises, never
@@ -136,6 +152,9 @@ EVENT_CATALOG = frozenset({
     "stream_batch_start", "stream_batch_commit", "stream_batch_capped",
     "stream_batch_error", "stream_incremental_merge",
     "stream_incremental_skip",
+    # serving caches (serving/)
+    "cache_hit", "cache_miss", "cache_store", "cache_invalidate",
+    "cache_evict", "cache_quarantine",
 })
 
 

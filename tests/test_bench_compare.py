@@ -134,6 +134,51 @@ def test_cli_compare_only_mode_never_runs_the_bench(tmp_path):
     assert "wall_per_dispatch_s" in bad.stdout
 
 
+def _serving(hit_rate=0.95, gold_p50=120.0, bronze_p50=6.0):
+    return {
+        "metric": "serving_stress",
+        "schema_version": bench.SCHEMA_VERSION,
+        "rounds": {
+            "none": {
+                "inject": "none",
+                "warm": {
+                    "cache_hit_rate": hit_rate,
+                    "per_tier": {
+                        "gold": {"p50_ms": gold_p50, "p95_ms": 300.0},
+                        "bronze": {"p50_ms": bronze_p50, "p95_ms": 9.0},
+                    },
+                },
+            },
+            "corrupt": {"inject": "corrupt", "warm": {"skipped": "budget"}},
+        },
+    }
+
+
+def test_serving_self_compare_clean_and_warm_p50_regression():
+    base = _serving()
+    assert bench.compare_summaries(base, copy.deepcopy(base)) == []
+    # +30% AND past the absolute floor: flagged
+    regs = bench.compare_summaries(base, _serving(gold_p50=200.0))
+    assert [(r["query"], r["field"]) for r in regs] == \
+        [("serving.none.gold", "warm_p50_ms")]
+    # a 2x ratio UNDER the floor is cache-hit jitter, not regression
+    assert bench.compare_summaries(base, _serving(bronze_p50=12.0)) == []
+    # improvements are never flagged
+    assert bench.compare_summaries(base, _serving(gold_p50=40.0)) == []
+
+
+def test_serving_lost_cache_hit_coverage_flagged():
+    base = _serving()
+    regs = bench.compare_summaries(base, _serving(hit_rate=0.3))
+    assert [(r["query"], r["field"]) for r in regs] == \
+        [("serving.none", "cache_hit_rate")]
+    # within-threshold wobble is fine
+    assert bench.compare_summaries(base, _serving(hit_rate=0.85)) == []
+    # artifacts without serving rounds skip the section entirely
+    assert bench.compare_summaries(_summary(), _serving()) == []
+    assert bench.compare_summaries(_serving(), _summary()) == []
+
+
 def _multichip(**elastic):
     tail = ("entry ok: ...\n"
             "MULTICHIP_ELASTIC " + json.dumps({

@@ -1,0 +1,403 @@
+"""Sub-second serving caches (spark_rapids_tpu/serving/ — ISSUE 19).
+
+The serving subsystem's standing promise: a cached answer is
+BIT-IDENTICAL to a cold recompute or it is not served at all.
+
+* plan-template cache — re-planning an already-seen query shape reuses
+  the cached optimized physical tree, and the result stays identical
+  to the cold plan on real TPC-H shapes, including under the
+  corrupt/OOM/stage-crash injection suite;
+* result cache — a repeated ``submit()`` of the same query over
+  unchanged inputs is served from disk (``exec_path == "cache"``);
+  appending or rewriting a source file makes the entry unreachable
+  (fresh stat pass -> new query fingerprint) and sweeps the stale
+  sibling — never a stale answer;
+* eviction — the on-disk byte budget holds via LRU eviction;
+* attribution — concurrent mixed-tenant submits count their hits on
+  the right tenant (``scheduler.tenant.<t>.cacheHits``);
+* fingerprints — recovery and serving derive identity from the SAME
+  helper (``recovery.manager.plan_fingerprints``) and can never drift;
+* streaming — a maintained stream registers each committed cumulative
+  result, so an ad-hoc ``submit()`` of the same query between ticks is
+  a cache hit, and the ledger commit invalidates entries whose source
+  files were rewritten.
+"""
+import os
+import threading
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.benchmarks import tpch, tpch_datagen
+
+FAST = {
+    "spark.rapids.tpu.memory.retry.backoffBaseMs": 0.1,
+    "spark.rapids.tpu.memory.retry.backoffMaxMs": 2.0,
+    "spark.rapids.tpu.sql.broadcastSizeThreshold": 0,
+}
+
+
+def _conf(tmp_path, **extra):
+    conf = dict(FAST)
+    conf.update({
+        "spark.rapids.tpu.serving.cache.enabled": True,
+        "spark.rapids.tpu.serving.cache.dir": str(tmp_path / "serving"),
+        "spark.rapids.tpu.recovery.dir": str(tmp_path / "rec"),
+        "spark.rapids.tpu.telemetry.enabled": True,
+    })
+    conf.update(extra)
+    return conf
+
+
+def _norm(rows):
+    return sorted(
+        (tuple((None if v is None else
+                (round(v, 9) if isinstance(v, float) else v))
+               for v in r) for r in rows),
+        key=repr)
+
+
+def _batch_rows(hb):
+    return _norm(zip(*[c.to_pylist() for c in hb.columns]))
+
+
+def _write_part(data_dir, name, a_vals, b_vals):
+    os.makedirs(data_dir, exist_ok=True)
+    pq.write_table(
+        pa.table({"a": pa.array(a_vals, type=pa.int64()),
+                  "b": pa.array(b_vals, type=pa.float64())}),
+        os.path.join(data_dir, name))
+
+
+def _serving_metric(sess, name):
+    return sess.export_metrics().get(name, 0)
+
+
+# ==========================================================================
+# Plan-template cache: bit-identity to the cold plan on TPC-H shapes
+# ==========================================================================
+@pytest.mark.parametrize("qnum", [1, 3, 5, 6])
+def test_template_cache_hit_bit_identical_tpch(qnum, tmp_path):
+    """Rebuilding the same TPC-H query from scratch normalizes to the
+    cached template — planning is skipped and the answer is identical
+    to the cold plan's."""
+    sess = srt.Session(_conf(tmp_path))
+    try:
+        tables = tpch_datagen.dataframes(sess, sf=0.001)
+        cold = _norm(tpch.QUERIES[qnum](tables).collect())
+        hits0 = _serving_metric(sess, "serving.template.hits")
+        # a brand-new logical tree of the same shape: the per-plan
+        # cache cannot help, only the template cache can
+        warm = _norm(tpch.QUERIES[qnum](tables).collect())
+        assert warm == cold
+        assert _serving_metric(sess, "serving.template.hits") > hits0
+    finally:
+        sess.close()
+
+
+@pytest.mark.fault_injection
+@pytest.mark.parametrize("fault", ["corrupt", "oom", "stage_crash"])
+def test_cached_results_bit_identical_under_injection(fault, tmp_path):
+    """Under each injection mode: the first submit survives the fault
+    (retries / checkpoint recovery), its STORED result is the correct
+    one, and the replay is served from cache bit-identical to a clean
+    oracle."""
+    site = "exchange.read" if fault == "stage_crash" else "exchange.write"
+    oracle_sess = srt.Session(dict(FAST))
+    oracle = _norm(tpch.QUERIES[3](
+        tpch_datagen.dataframes(oracle_sess, sf=0.001)).collect())
+    oracle_sess.close()
+
+    sess = srt.Session(_conf(tmp_path, **{
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.sql.taskRetries": 3,
+        "spark.rapids.tpu.fault.injection.mode": "nth",
+        "spark.rapids.tpu.fault.injection.type": fault,
+        "spark.rapids.tpu.fault.injection.site": site,
+        "spark.rapids.tpu.fault.injection.skipCount": 1,
+    }))
+    try:
+        tables = tpch_datagen.dataframes(sess, sf=0.001)
+        h1 = sess.submit(tpch.QUERIES[3](tables))
+        out1 = h1.result(timeout=120)
+        assert _batch_rows(out1) == oracle
+        h2 = sess.submit(tpch.QUERIES[3](tables))
+        out2 = h2.result(timeout=120)
+        assert h2.exec_path == "cache", h2.exec_path
+        assert _batch_rows(out2) == oracle
+    finally:
+        sess.close()
+
+
+# ==========================================================================
+# Result cache: invalidation on source-file append and rewrite
+# ==========================================================================
+def test_result_cache_never_stale_after_append_or_rewrite(tmp_path):
+    data = tmp_path / "data"
+    _write_part(data, "part-0.parquet", [1, 2, 3, 4], [1.0, 2.0, 3.0, 4.0])
+    sess = srt.Session(_conf(tmp_path))
+    try:
+        def q():
+            df = sess.read_parquet(str(data))
+            return df.filter(srt.f.col("a") < 100).group_by().agg(
+                srt.f.sum("b").alias("s"))
+
+        h1 = sess.submit(q())
+        assert _batch_rows(h1.result(timeout=60)) == [(10.0,)]
+        assert h1.exec_path != "cache"
+        h2 = sess.submit(q())
+        assert h2.exec_path == "cache"
+        assert _batch_rows(h2.result(timeout=60)) == [(10.0,)]
+
+        # append: fresh stat pass -> new query_fp -> the old entry is
+        # unreachable; the correct new answer is computed and stored
+        _write_part(data, "part-1.parquet", [5], [5.0])
+        h3 = sess.submit(q())
+        assert h3.exec_path != "cache"
+        assert _batch_rows(h3.result(timeout=60)) == [(15.0,)]
+        h4 = sess.submit(q())
+        assert h4.exec_path == "cache"
+        assert _batch_rows(h4.result(timeout=60)) == [(15.0,)]
+
+        # rewrite in place: same file COUNT (same plan_fp), different
+        # content — the fresh stat pass proves the sibling entry stale
+        # and sweeps it on sight, and the answer is never the old one
+        _write_part(data, "part-0.parquet", [1], [1.0])
+        h5 = sess.submit(q())
+        assert h5.exec_path != "cache"
+        assert _batch_rows(h5.result(timeout=60)) == [(6.0,)]
+        assert _serving_metric(sess, "serving.result.invalidated") >= 1
+    finally:
+        sess.close()
+
+
+# ==========================================================================
+# Eviction under the byte budget
+# ==========================================================================
+def test_result_cache_eviction_under_byte_budget(tmp_path):
+    data = tmp_path / "data"
+    _write_part(data, "part-0.parquet", list(range(20)),
+                [float(i) for i in range(20)])
+    sess = srt.Session(_conf(tmp_path, **{
+        "spark.rapids.tpu.serving.cache.results.maxBytes": 2500,
+    }))
+    try:
+        def q(n):
+            df = sess.read_parquet(str(data))
+            return df.filter(srt.f.col("a") < n).group_by().agg(
+                srt.f.sum("b").alias("s"))
+
+        for n in (5, 6, 7, 8, 9, 10):
+            sess.submit(q(n)).result(timeout=60)
+        m = sess.export_metrics()
+        assert m["serving.result.stores"] >= 4
+        assert m["serving.result.evicted"] >= 1
+        # the on-disk footprint respects the budget
+        total = 0
+        root = str(tmp_path / "serving")
+        for dirpath, _dirs, files in os.walk(root):
+            total += sum(os.path.getsize(os.path.join(dirpath, f))
+                         for f in files)
+        assert total <= 2500, total
+        # the most recent entry survived and still hits
+        h = sess.submit(q(10))
+        assert h.exec_path == "cache"
+        assert _batch_rows(h.result(timeout=60)) == [(45.0,)]
+    finally:
+        sess.close()
+
+
+# ==========================================================================
+# Concurrent mixed-tenant submits: per-tenant hit attribution
+# ==========================================================================
+def test_concurrent_mixed_tenant_hits_attributed(tmp_path):
+    data = tmp_path / "data"
+    _write_part(data, "part-0.parquet", [1, 2, 3], [1.0, 2.0, 3.0])
+    sess = srt.Session(_conf(tmp_path, **{
+        "spark.rapids.tpu.scheduler.tenant.gold.weight": 4.0,
+        "spark.rapids.tpu.scheduler.tenant.bronze.weight": 1.0,
+    }))
+    try:
+        def q():
+            df = sess.read_parquet(str(data))
+            return df.group_by().agg(srt.f.sum("b").alias("s"))
+
+        sess.submit(q()).result(timeout=60)  # prime
+
+        per_tenant = {"gold": 7, "bronze": 3}
+        results = {t: [] for t in per_tenant}
+        errors = []
+
+        def drive(tenant, n):
+            try:
+                for _ in range(n):
+                    h = sess.submit(q(), tenant=tenant)
+                    results[tenant].append(
+                        (h.exec_path, _batch_rows(h.result(timeout=60))))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=drive, args=(t, n))
+                   for t, n in per_tenant.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for tenant, n in per_tenant.items():
+            assert len(results[tenant]) == n
+            assert all(rows == [(6.0,)] for _p, rows in results[tenant])
+            assert all(p == "cache" for p, _r in results[tenant])
+        qos = sess.scheduler.qos_metrics()
+        for tenant, n in per_tenant.items():
+            assert qos[f"scheduler.tenant.{tenant}.cacheHits"] == n
+    finally:
+        sess.close()
+
+
+# ==========================================================================
+# Fingerprint parity: recovery and serving share ONE identity
+# ==========================================================================
+def test_recovery_and_serving_fingerprints_identical(tmp_path):
+    """Regression pin for the shared helper: ``RecoveryManager
+    .attach_query`` and ``ResultCache.fingerprint`` must agree on the
+    query fingerprint of the same plan — a drift here would make the
+    result cache key results recovery can't find (or vice versa)."""
+    from spark_rapids_tpu.recovery.manager import RecoveryManager
+    from spark_rapids_tpu.serving.result_cache import ResultCache
+
+    sess = srt.Session(_conf(tmp_path, **{
+        "spark.rapids.tpu.recovery.enabled": True,
+    }))
+    try:
+        tables = tpch_datagen.dataframes(sess, sf=0.001)
+        for qnum in (1, 6):
+            plan = tpch.QUERIES[qnum](tables).plan
+            mgr = RecoveryManager(sess.conf)
+            mgr.attach_query(plan)
+            key = ResultCache(sess.conf).fingerprint(plan)
+            assert mgr.query_fp is not None
+            assert key is not None
+            assert key.query_fp == mgr.query_fp
+            # and the computation is stable call-to-call
+            again = ResultCache(sess.conf).fingerprint(plan)
+            assert (again.plan_fp, again.query_fp) == \
+                (key.plan_fp, key.query_fp)
+    finally:
+        sess.close()
+
+
+# ==========================================================================
+# Prepared statements
+# ==========================================================================
+def test_prepared_statement_extracts_and_rebinds(tmp_path):
+    sess = srt.Session(_conf(tmp_path))
+    try:
+        tables = tpch_datagen.dataframes(sess, sf=0.001)
+        nation = tables["nation"]
+        ps = sess.prepare(nation.filter(srt.f.col("n_nationkey") < 10))
+        assert ps.num_params >= 1
+        assert 10 in ps.defaults
+        idx = ps.defaults.index(10)
+
+        base = ps.execute()
+        assert base.num_rows == 10
+        rebound = list(ps.defaults)
+        rebound[idx] = 5
+        assert ps.execute(rebound).num_rows == 5
+        # a re-bound synchronous execute equals the plain DataFrame run
+        assert _batch_rows(ps.execute(rebound)) == _norm(
+            nation.filter(srt.f.col("n_nationkey") < 5).collect())
+
+        with pytest.raises(ValueError):
+            ps.execute(list(ps.defaults) + [1])  # arity
+        with pytest.raises(ValueError):
+            bad = list(ps.defaults)
+            bad[idx] = "not-a-number"            # dtype
+            ps.execute(bad)
+
+        # submit path: the second identical binding is a result-cache hit
+        h1 = ps.submit()
+        h1.result(timeout=60)
+        h2 = ps.submit()
+        assert h2.exec_path == "cache"
+        assert _batch_rows(h2.result(timeout=60)) == _batch_rows(base)
+    finally:
+        sess.close()
+
+
+# ==========================================================================
+# Streaming composition: ticks feed the result cache
+# ==========================================================================
+def test_stream_result_served_to_adhoc_submit_between_ticks(tmp_path):
+    data = tmp_path / "data"
+    _write_part(data, "part-0.parquet", [1, 2, 3], [1.0, 2.0, 3.0])
+    sess = srt.Session(_conf(tmp_path, **{
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.streaming.enabled": True,
+    }))
+
+    def q():
+        df = sess.read_parquet(str(data))
+        return df.group_by().agg(srt.f.sum("b").alias("s"))
+
+    h = sess.stream(q(), trigger=0)
+    try:
+        out1 = h.process_available()
+        assert _batch_rows(out1) == [(6.0,)]
+        # the committed cumulative result was registered: an ad-hoc
+        # submit of the same query between ticks never executes
+        a1 = sess.submit(q())
+        assert a1.exec_path == "cache", a1.exec_path
+        assert _batch_rows(a1.result(timeout=60)) == [(6.0,)]
+
+        # a new file lands BEFORE the next tick: the ad-hoc submit must
+        # see the grown input (new fingerprint -> miss), never stale
+        _write_part(data, "part-1.parquet", [4], [4.0])
+        a2 = sess.submit(q())
+        assert a2.exec_path != "cache"
+        assert _batch_rows(a2.result(timeout=60)) == [(10.0,)]
+
+        out2 = h.process_available()
+        assert _batch_rows(out2) == [(10.0,)]
+        a3 = sess.submit(q())
+        assert a3.exec_path == "cache"
+        assert _batch_rows(a3.result(timeout=60)) == [(10.0,)]
+    finally:
+        h.stop()
+        sess.close()
+
+
+def test_stream_ledger_commit_invalidates_rewritten_sources(tmp_path):
+    """Rewriting a committed file breaks the append-only contract: the
+    tick degrades to a full recompute (still correct) and the ledger
+    commit eagerly drops every serving entry derived from the
+    rewritten source's files."""
+    data = tmp_path / "data"
+    _write_part(data, "part-0.parquet", [1, 2], [1.0, 2.0])
+    sess = srt.Session(_conf(tmp_path, **{
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.streaming.enabled": True,
+    }))
+
+    def q():
+        df = sess.read_parquet(str(data))
+        return df.group_by().agg(srt.f.sum("b").alias("s"))
+
+    h = sess.stream(q(), trigger=0)
+    try:
+        assert _batch_rows(h.process_available()) == [(3.0,)]
+        assert sess.submit(q()).exec_path == "cache"
+
+        _write_part(data, "part-0.parquet", [7, 8, 9],
+                    [7.0, 8.0, 9.0])
+        out2 = h.process_available()
+        assert _batch_rows(out2) == [(24.0,)]
+        assert _serving_metric(sess, "serving.result.invalidated") >= 1
+        a = sess.submit(q())
+        assert _batch_rows(a.result(timeout=60)) == [(24.0,)]
+    finally:
+        h.stop()
+        sess.close()
